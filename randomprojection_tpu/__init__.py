@@ -1,0 +1,40 @@
+"""randomprojection_tpu — a TPU-native random-projection framework.
+
+Capabilities of ``afcarl/RandomProjection`` (Johnson–Lindenstrauss random
+projection with Gaussian and sparse Achlioptas/Li kernels, JL
+auto-dimensioning, streamed row-batch transform, plus the structured-RP
+siblings sign-RP/SimHash and Count-Sketch), re-designed TPU-first:
+jit-compiled XLA einsums behind a ``ProjectionBackend`` plugin boundary,
+on-device ``jax.random`` matrix generation, and ``shard_map`` data/tensor
+parallelism over a ``jax.sharding.Mesh``.
+
+See ``SURVEY.md`` for the structural blueprint and provenance of every
+behavioral contract cited in docstrings.
+"""
+
+from randomprojection_tpu.jl import johnson_lindenstrauss_min_dim
+from randomprojection_tpu.utils.validation import (
+    DataDimensionalityWarning,
+    NotFittedError,
+)
+
+__version__ = "0.1.0"
+
+_LAZY_ESTIMATORS = ()  # populated as model families land in randomprojection_tpu.models
+
+__all__ = [
+    "johnson_lindenstrauss_min_dim",
+    "DataDimensionalityWarning",
+    "NotFittedError",
+    *_LAZY_ESTIMATORS,
+]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import randomprojection_tpu` cheap (no jax import
+    # until an estimator or backend is actually touched).
+    if name in _LAZY_ESTIMATORS:
+        from randomprojection_tpu import models
+
+        return getattr(models, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

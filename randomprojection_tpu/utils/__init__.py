@@ -1,0 +1,17 @@
+from randomprojection_tpu.utils.validation import (
+    DataDimensionalityWarning,
+    NotFittedError,
+    check_array,
+    check_density,
+    check_input_size,
+    resolve_transform_dtype,
+)
+
+__all__ = [
+    "DataDimensionalityWarning",
+    "NotFittedError",
+    "check_array",
+    "check_density",
+    "check_input_size",
+    "resolve_transform_dtype",
+]

@@ -1,0 +1,30 @@
+"""Test harness config.
+
+Multi-chip strategy (SURVEY.md §5): all sharding tests run on a virtual
+8-device CPU mesh via XLA_FLAGS, in plain pytest, before jax is imported
+anywhere.  The same sharded code then runs unmodified on a real TPU slice;
+the driver's dryrun_multichip covers the compile path separately.
+"""
+
+import os
+import sys
+
+# Must happen before any jax import (jax reads these at first import).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

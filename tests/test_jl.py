@@ -1,0 +1,63 @@
+"""L0 tests: JL auto-dim + validation (SURVEY.md §5 category 1/4).
+
+Contract anchors: sklearn test_random_projection.py:81-110 (invalid domain),
+:347-371 (auto-dim values), :451-456 (32-bit regression).
+"""
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu import johnson_lindenstrauss_min_dim
+from randomprojection_tpu.utils import check_density, check_input_size
+
+
+def test_invalid_jl_domain():
+    for n, eps in [(100, 1.1), (100, 0.0), (100, -0.1), (0, 0.5), (-10, 0.5)]:
+        with pytest.raises(ValueError):
+            johnson_lindenstrauss_min_dim(n, eps=eps)
+    # array-valued invalids raise too
+    with pytest.raises(ValueError):
+        johnson_lindenstrauss_min_dim(np.array([10, 0]), eps=0.5)
+    with pytest.raises(ValueError):
+        johnson_lindenstrauss_min_dim(100, eps=np.array([0.5, 1.0]))
+
+
+def test_jl_matches_sklearn():
+    from sklearn.random_projection import (
+        johnson_lindenstrauss_min_dim as sk_jl,
+    )
+
+    for n in (10, 100, 10_000, 1_000_000):
+        for eps in (0.05, 0.1, 0.2, 0.5, 0.999):
+            assert johnson_lindenstrauss_min_dim(n, eps=eps) == sk_jl(n, eps=eps)
+
+
+def test_jl_known_values():
+    # sklearn test_random_projection.py:347-371: (n=10, eps=0.5) -> 110
+    assert johnson_lindenstrauss_min_dim(10, eps=0.5) == 110
+    # 64-bit regression (test_random_projection.py:451-456)
+    assert johnson_lindenstrauss_min_dim(100, eps=1e-5) == 368416070986
+
+
+def test_jl_array_inputs():
+    out = johnson_lindenstrauss_min_dim(np.array([10, 10]), eps=0.5)
+    np.testing.assert_array_equal(out, [110, 110])
+    out = johnson_lindenstrauss_min_dim(10, eps=np.array([0.5, 0.5]))
+    np.testing.assert_array_equal(out, [110, 110])
+    assert isinstance(johnson_lindenstrauss_min_dim(10, eps=0.5), int)
+
+
+def test_check_density():
+    assert check_density("auto", 1000) == pytest.approx(1 / np.sqrt(1000))
+    assert check_density(1 / 3, 100) == pytest.approx(1 / 3)
+    assert check_density(1.0, 100) == 1.0
+    for bad in (0.0, -0.5, 1.1):
+        with pytest.raises(ValueError):
+            check_density(bad, 100)
+
+
+def test_check_input_size():
+    check_input_size(5, 10)
+    for k, d in [(0, 10), (-1, 10), (5, 0), (5, -3)]:
+        with pytest.raises(ValueError):
+            check_input_size(k, d)
