@@ -1,0 +1,6 @@
+"""Projection kernels and fused ops (layer L3/L1).
+
+``kernels`` — on-device jax.random generators (blocked, counter-based).
+``numpy_kernels`` — host NumPy generators (numpy backend / parity oracle).
+``pallas_kernels`` — fused Pallas TPU kernels (lazy mask regeneration; planned).
+"""

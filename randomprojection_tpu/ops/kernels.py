@@ -1,0 +1,188 @@
+"""On-device projection-matrix kernels (layer L3), TPU-first.
+
+Math contract (see SURVEY.md §1; canonical open-source anchor
+``sklearn/random_projection.py``):
+
+- Gaussian kernel: ``R[i, j] ~ N(0, 1/k)`` i.i.d. (RP.py:203-205).
+- Sparse Achlioptas/Li kernel with ``s = 1/density``:
+  ``R[i, j] ∈ {-sqrt(s/k), 0, +sqrt(s/k)}`` with probabilities
+  ``{1/2s, 1 - 1/s, 1/2s}`` (RP.py:216-221, 274-305).  ``density=1``
+  degenerates to dense Rademacher ``±1/sqrt(k)``.
+- Rademacher (sign-RP) kernel: ``R[i, j] ∈ {-1, +1}/sqrt(k)`` each w.p. 1/2.
+
+TPU-first design decisions
+--------------------------
+**Blocked, counter-based definition.**  ``R`` is *defined* as a sequence of
+column blocks of fixed width ``COLUMN_BLOCK``; block ``b`` is a pure function
+of ``jax.random.fold_in(key, b)``.  Consequences:
+
+- The same ``(key, k, d)`` yields the *same matrix* no matter how the
+  computation is laid out: full materialization, per-shard materialization
+  under tensor parallelism (each chip builds only its column blocks), or
+  lazy regeneration inside a fused kernel.  This resolves SURVEY.md §8's
+  "PRNG parity vs streaming layout" hazard by construction.
+- Blocks use the counter-based threefry PRNG, so generation is embarrassingly
+  parallel and reproducible across meshes and JAX versions with the same
+  PRNG implementation.
+
+**Single-uniform trick for the sparse kernel.**  One uniform draw per entry
+decides zero/sign: ``u < density/2 → +v``, ``u < density → -v``, else 0.
+This is i.i.d.-equivalent to the reference's per-row binomial + index
+sampling + sign flips (RP.py:284-297) but vectorizes to a pure elementwise
+op on device — no Python row loop, no CSR assembly.
+
+Sparse matrices are returned *dense* on device: on TPU the MXU consumes
+dense bf16/f32 tiles, and a k×d projection matrix is small (256×4096 f32 =
+4 MiB).  For huge ``k·d`` the mask is regenerated lazily block-by-block
+(``ops/pallas_kernels.py``, planned; same block definition) instead of ever
+being resident in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from randomprojection_tpu.utils.validation import check_density, check_input_size
+
+__all__ = [
+    "COLUMN_BLOCK",
+    "num_column_blocks",
+    "block_key",
+    "gaussian_block",
+    "sparse_block",
+    "rademacher_block",
+    "gaussian_matrix",
+    "sparse_matrix",
+    "rademacher_matrix",
+    "materialize_columns",
+]
+
+# Canonical column-block width.  Part of the matrix *definition*: changing it
+# changes every generated matrix, so it is a constant, not a knob.  512 lanes
+# = 4 TPU vregs wide, and divides the lane tiling of every supported dtype.
+COLUMN_BLOCK = 512
+
+
+def num_column_blocks(n_features: int) -> int:
+    return -(-n_features // COLUMN_BLOCK)
+
+
+def block_key(key: jax.Array, block_index) -> jax.Array:
+    """The PRNG key owning column block ``block_index`` of the matrix."""
+    return jax.random.fold_in(key, block_index)
+
+
+def _block_width(n_features: int, block_index: int) -> int:
+    """Width of block ``block_index`` (the last block may be ragged)."""
+    return min(COLUMN_BLOCK, n_features - block_index * COLUMN_BLOCK)
+
+
+# ---------------------------------------------------------------------------
+# Per-block generators (pure; jit-friendly; static shapes)
+# ---------------------------------------------------------------------------
+
+
+def gaussian_block(key, block_index, n_components, width, dtype=jnp.float32):
+    """Column block of the Gaussian kernel: entries i.i.d. N(0, 1/k)."""
+    bkey = block_key(key, block_index)
+    std = 1.0 / math.sqrt(n_components)
+    return (jax.random.normal(bkey, (n_components, width), dtype=jnp.float32) * std).astype(dtype)
+
+
+def sparse_block(key, block_index, n_components, width, density, dtype=jnp.float32):
+    """Column block of the Achlioptas/Li sparse kernel.
+
+    Entries are i.i.d. ``{+v, -v, 0}`` with probabilities
+    ``{density/2, density/2, 1-density}`` where ``v = 1/sqrt(density * k)``
+    (equal to ``sqrt(s/k)`` with ``s = 1/density`` — RP.py:305).
+    """
+    bkey = block_key(key, block_index)
+    u = jax.random.uniform(bkey, (n_components, width), dtype=jnp.float32)
+    v = 1.0 / math.sqrt(density * n_components)
+    plus = (u < density / 2).astype(jnp.float32)
+    minus = ((u >= density / 2) & (u < density)).astype(jnp.float32)
+    return ((plus - minus) * v).astype(dtype)
+
+
+def rademacher_block(key, block_index, n_components, width, dtype=jnp.float32):
+    """Column block of the sign/Rademacher kernel: ±1/sqrt(k) each w.p. 1/2."""
+    bkey = block_key(key, block_index)
+    bits = jax.random.bernoulli(bkey, 0.5, (n_components, width))
+    v = 1.0 / math.sqrt(n_components)
+    return jnp.where(bits, v, -v).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Full-matrix materialization (concatenation of blocks)
+# ---------------------------------------------------------------------------
+
+
+def _materialize(block_fn, key, n_components, n_features, dtype):
+    check_input_size(n_components, n_features)
+    blocks = []
+    for b in range(num_column_blocks(n_features)):
+        w = _block_width(n_features, b)
+        blocks.append(block_fn(key, b, n_components, w, dtype=dtype))
+    return jnp.concatenate(blocks, axis=1) if len(blocks) > 1 else blocks[0]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def gaussian_matrix(key, n_components, n_features, dtype=jnp.float32):
+    """Materialize the full ``(k, d)`` Gaussian projection matrix on device."""
+    return _materialize(gaussian_block, key, n_components, n_features, dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def sparse_matrix(key, n_components, n_features, density, dtype=jnp.float32):
+    """Materialize the full ``(k, d)`` sparse (Achlioptas/Li) matrix, dense layout.
+
+    ``density`` must be numeric in (0, 1] (resolve ``'auto'`` with
+    ``check_density`` first — done at the estimator layer).
+    """
+    density = check_density(density, n_features)
+    block_fn = functools.partial(sparse_block, density=density)
+    return _materialize(block_fn, key, n_components, n_features, dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def rademacher_matrix(key, n_components, n_features, dtype=jnp.float32):
+    """Materialize the full ``(k, d)`` sign-RP matrix on device."""
+    return _materialize(rademacher_block, key, n_components, n_features, dtype)
+
+
+def materialize_columns(
+    block_fn, key, n_components, n_features, col_start, col_end, dtype=jnp.float32
+):
+    """Materialize columns ``[col_start, col_end)`` of the ``(k, n_features)`` matrix.
+
+    Used by the tensor-parallel path: a chip owning a column shard builds
+    exactly its blocks, and the result is bit-identical to slicing the full
+    matrix.  Bit-identity requires generating each block at its *canonical*
+    width (threefry output depends on the array shape), so ``col_start`` must
+    be COLUMN_BLOCK-aligned and ``col_end`` aligned or at the matrix edge.
+    """
+    if col_start % COLUMN_BLOCK != 0:
+        raise ValueError(
+            f"col_start must be aligned to COLUMN_BLOCK={COLUMN_BLOCK}, got {col_start}"
+        )
+    if col_end % COLUMN_BLOCK != 0 and col_end != n_features:
+        raise ValueError(
+            f"col_end must be COLUMN_BLOCK-aligned or equal to n_features="
+            f"{n_features}, got {col_end}"
+        )
+    if not 0 <= col_start < col_end <= n_features:
+        raise ValueError(
+            f"Expected 0 <= col_start < col_end <= n_features={n_features}, "
+            f"got [{col_start}, {col_end})"
+        )
+    blocks = []
+    b0 = col_start // COLUMN_BLOCK
+    b1 = -(-col_end // COLUMN_BLOCK)
+    for b in range(b0, b1):
+        w = _block_width(n_features, b)
+        blocks.append(block_fn(key, b, n_components, w, dtype=dtype))
+    return jnp.concatenate(blocks, axis=1) if len(blocks) > 1 else blocks[0]

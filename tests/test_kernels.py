@@ -1,0 +1,117 @@
+"""Statistical property tests of generated projection matrices
+(SURVEY.md §5 category 2; contract anchors test_random_projection.py:122-220,
+:391-397) — run against BOTH the numpy and the jax kernels, plus
+determinism/blocking invariance tests for the counter-based jax definition.
+"""
+
+import math
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from randomprojection_tpu.ops import kernels as jk
+from randomprojection_tpu.ops import numpy_kernels as nk
+
+K, D = 256, 2048  # big enough for ±2-decimal statistics, fast enough for CI
+
+
+def _jax_gaussian():
+    return np.asarray(jk.gaussian_matrix(jax.random.key(42), K, D))
+
+
+def _np_gaussian():
+    return nk.gaussian_random_matrix(K, D, np.random.default_rng(42))
+
+
+def _jax_sparse(density):
+    return np.asarray(jk.sparse_matrix(jax.random.key(42), K, D, density))
+
+
+def _np_sparse(density):
+    m = nk.sparse_random_matrix(K, D, density, np.random.default_rng(42))
+    return m.toarray() if sp.issparse(m) else np.asarray(m)
+
+
+@pytest.mark.parametrize("make", [_jax_gaussian, _np_gaussian], ids=["jax", "numpy"])
+def test_gaussian_statistics(make):
+    R = make()
+    assert R.shape == (K, D)
+    # zero mean, variance 1/k (test_random_projection.py:157-168)
+    assert abs(R.mean()) < 3.0 / math.sqrt(K * D)
+    np.testing.assert_allclose(R.var(), 1.0 / K, rtol=0.05)
+    # unit expected column norm (test_random_projection.py:122-129)
+    np.testing.assert_allclose(np.mean(np.sum(R**2, axis=0)), 1.0, rtol=0.05)
+
+
+@pytest.mark.parametrize("make", [_jax_sparse, _np_sparse], ids=["jax", "numpy"])
+@pytest.mark.parametrize("density", [1 / 3, 0.01, 1.0])
+def test_sparse_statistics(make, density):
+    R = make(density)
+    assert R.shape == (K, D)
+    v = 1.0 / math.sqrt(density * K)
+    # value set {0, ±v} (test_random_projection.py:171-220); round at f32
+    # precision since the jax kernel generates float32
+    values = set(np.unique(np.round(R.astype(np.float64), 6)))
+    expected = {0.0, v, -v} if density < 1 else {v, -v}
+    assert {round(x, 6) for x in expected} == values
+    # realized density within tolerance (test_random_projection.py:391-397)
+    nnz_frac = np.mean(R != 0)
+    np.testing.assert_allclose(nnz_frac, density, rtol=0.1)
+    # symmetric signs => near-zero mean; per-entry variance = v^2 * density = 1/k
+    np.testing.assert_allclose(R.var(), 1.0 / K, rtol=0.05)
+    np.testing.assert_allclose(np.mean(np.sum(R**2, axis=0)), 1.0, rtol=0.05)
+
+
+def test_numpy_sparse_is_csr():
+    m = nk.sparse_random_matrix(8, 100, 0.1, np.random.default_rng(0))
+    assert sp.issparse(m) and m.format == "csr"
+    dense = nk.sparse_random_matrix(8, 100, 1.0, np.random.default_rng(0))
+    assert isinstance(dense, np.ndarray)
+
+
+def test_jax_determinism_and_key_sensitivity():
+    a = _jax_gaussian()
+    b = _jax_gaussian()
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(jk.gaussian_matrix(jax.random.key(43), K, D))
+    assert not np.array_equal(a, c)
+
+
+def test_blocked_definition_shard_identity():
+    """A column shard built block-by-block == the slice of the full matrix.
+
+    This is the property that makes tensor-parallel generation and lazy
+    regeneration exact (SURVEY.md §8 'PRNG parity vs streaming layout').
+    """
+    key = jax.random.key(7)
+    d = 2 * jk.COLUMN_BLOCK + 100  # ragged last block
+    full = jk.gaussian_matrix(key, 16, d)
+    for start, end in [(0, jk.COLUMN_BLOCK), (jk.COLUMN_BLOCK, d)]:
+        shard = jk.materialize_columns(jk.gaussian_block, key, 16, d, start, end)
+        np.testing.assert_array_equal(np.asarray(full[:, start:end]), np.asarray(shard))
+    # misaligned shards are rejected, not silently wrong
+    with pytest.raises(ValueError):
+        jk.materialize_columns(jk.gaussian_block, key, 16, d, 3, 100)
+    with pytest.raises(ValueError):
+        jk.materialize_columns(jk.gaussian_block, key, 16, d, 0, 100)
+
+
+def test_rademacher_statistics():
+    R = np.asarray(jk.rademacher_matrix(jax.random.key(1), K, D))
+    v = 1.0 / math.sqrt(K)
+    assert set(np.round(np.unique(R), 12)) == set(np.round([v, -v], 12))
+    assert abs(R.mean()) < 3.0 * v / math.sqrt(K * D)
+    Rn = nk.rademacher_random_matrix(K, D, np.random.default_rng(1))
+    assert set(np.round(np.unique(Rn), 12)) == set(np.round([v, -v], 12))
+
+
+def test_bfloat16_dtype():
+    R = jk.gaussian_matrix(jax.random.key(0), 64, 512, dtype=jnp.bfloat16)
+    assert R.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(R, dtype=np.float32).var(), 1.0 / 64, rtol=0.1
+    )
