@@ -20,7 +20,11 @@ from randomprojection_tpu.utils.validation import (
 
 __version__ = "0.1.0"
 
-_LAZY_ESTIMATORS = ()  # populated as model families land in randomprojection_tpu.models
+_LAZY_ESTIMATORS = (
+    "BaseRandomProjection",
+    "GaussianRandomProjection",
+    "SparseRandomProjection",
+)
 
 __all__ = [
     "johnson_lindenstrauss_min_dim",

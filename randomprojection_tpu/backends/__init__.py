@@ -1,0 +1,28 @@
+"""Backend plugin boundary (layer L4).
+
+The reference gates execution behind a ``ProjectionBackend`` registry keyed
+by ``backend='numpy'|'spark'|'jax'`` (``BASELINE.json:5``; SURVEY.md §2 L4).
+Here ``numpy`` is the host parity oracle and ``jax`` is the TPU execution
+path; ``spark`` is out of scope (no pyspark in env — the sharded jax backend
+over a TPU mesh is its distributed replacement, SURVEY.md §3.4).
+
+``jax`` is imported lazily: ``get_backend('numpy')`` never pulls in jax.
+"""
+
+from randomprojection_tpu.backends.base import (
+    ProjectionBackend,
+    ProjectionSpec,
+    available_backends,
+    get_backend,
+    register_backend,
+    resolve_backend,
+)
+
+__all__ = [
+    "ProjectionBackend",
+    "ProjectionSpec",
+    "available_backends",
+    "get_backend",
+    "register_backend",
+    "resolve_backend",
+]

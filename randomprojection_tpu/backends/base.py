@@ -1,0 +1,185 @@
+"""``ProjectionBackend`` protocol, ``ProjectionSpec``, and the backend registry.
+
+This is the reference's plugin boundary (``BASELINE.json:5``: "gated behind
+the existing ProjectionBackend plugin boundary (backend='numpy'|'spark'|'jax'),
+so fit()/transform() ... stay unchanged"; SURVEY.md §2 layer L4).
+
+Design
+------
+A fitted projection is fully described by an immutable ``ProjectionSpec``
+(kind, shape, seed, density, dtype).  A backend turns a spec into *state*
+(its native representation of the projection matrix — ndarray, CSR, or a
+device-resident ``jax.Array``) and executes the three operations against
+that state:
+
+- ``materialize(spec)``      → state                 (fit-time)
+- ``transform(X, state, spec, dense_output)`` → Y    (the X·Rᵀ hot loop)
+- ``inverse_components(state, spec)`` → pinv(R)      (optional, fit-time)
+- ``inverse_transform(Y, inv)``       → X̂            (Y·pinv(R)ᵀ)
+
+Because the spec — not the materialized matrix — is the source of truth, a
+fitted model serializes as a few scalars (SURVEY.md §6 checkpoint/resume)
+and any backend can re-materialize it, enabling cross-backend save/load.
+Within a backend, materialization is deterministic in the seed; across
+backends only the *distribution* matches (different PRNGs — SURVEY.md §8).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+__all__ = [
+    "ProjectionSpec",
+    "ProjectionBackend",
+    "register_backend",
+    "get_backend",
+    "resolve_backend",
+    "available_backends",
+]
+
+_VALID_KINDS = ("gaussian", "sparse", "rademacher")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectionSpec:
+    """Immutable description of one projection matrix.
+
+    ``density`` is the *resolved* numeric density (``'auto'`` → ``1/sqrt(d)``
+    happens at the estimator layer) and is ``None`` for non-sparse kinds.
+    ``dtype`` is the transform output dtype (the reference's dtype policy:
+    f32→f32, f64→f64, ints promote — ``random_projection.py:386-387``).
+    """
+
+    kind: str
+    n_components: int
+    n_features: int
+    seed: int
+    density: Optional[float] = None
+    dtype: str = "float64"
+
+    def __post_init__(self):
+        if self.kind not in _VALID_KINDS:
+            raise ValueError(
+                f"Unknown projection kind {self.kind!r}; expected one of {_VALID_KINDS}"
+            )
+        if self.kind == "sparse":
+            if self.density is None:
+                raise ValueError("kind='sparse' requires a resolved numeric density")
+        np.dtype(self.dtype)  # must be a valid dtype string
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(self.dtype)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProjectionSpec":
+        return cls(**d)
+
+
+class ProjectionBackend(abc.ABC):
+    """Executor for a projection spec.  Subclass + register to plug in."""
+
+    #: registry key; set by subclasses
+    name: str = ""
+
+    @abc.abstractmethod
+    def materialize(self, spec: ProjectionSpec) -> Any:
+        """Generate the projection matrix in backend-native form (fit-time)."""
+
+    @abc.abstractmethod
+    def transform(
+        self, X, state: Any, spec: ProjectionSpec, *, dense_output: bool = True
+    ):
+        """Compute ``X @ R.T`` for one batch ``X`` of shape ``(n, d)``.
+
+        ``dense_output=False`` asks sparse-aware backends to keep sparse
+        outputs sparse when ``X`` is sparse (scipy semantics,
+        ``random_projection.py:825-827``); dense-only backends may ignore it.
+        """
+
+    @abc.abstractmethod
+    def inverse_components(self, state: Any, spec: ProjectionSpec) -> np.ndarray:
+        """Moore–Penrose pseudo-inverse of R, shape ``(d, k)``."""
+
+    @abc.abstractmethod
+    def inverse_transform(self, Y, inverse_components, spec: ProjectionSpec):
+        """Compute ``Y @ pinv(R).T``, shape ``(n, d)``."""
+
+    def components_to_numpy(self, state: Any, spec: ProjectionSpec):
+        """Host copy of R for introspection/serialization (ndarray or CSR)."""
+        return np.asarray(state)
+
+    def close(self) -> None:
+        """Release backend resources (no-op by default)."""
+
+
+_REGISTRY: Dict[str, Callable[..., ProjectionBackend]] = {}
+_INSTANCES: Dict[str, ProjectionBackend] = {}
+
+
+def register_backend(name: str, factory: Callable[..., ProjectionBackend]) -> None:
+    """Register a backend factory under a string key (the plugin seam)."""
+    if not name or not isinstance(name, str):
+        raise ValueError(f"Backend name must be a non-empty string, got {name!r}")
+    _REGISTRY[name] = factory
+
+
+def available_backends() -> Iterable[str]:
+    _ensure_builtin_backends()
+    return tuple(sorted(_REGISTRY))
+
+
+def get_backend(name: str, **options) -> ProjectionBackend:
+    """Instantiate backend ``name``.  Option-free instances are cached."""
+    _ensure_builtin_backends()
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"Unknown backend {name!r}; available: {', '.join(sorted(_REGISTRY))}"
+        )
+    if not options:
+        if name not in _INSTANCES:
+            _INSTANCES[name] = _REGISTRY[name]()
+        return _INSTANCES[name]
+    return _REGISTRY[name](**options)
+
+
+def resolve_backend(backend, **options) -> ProjectionBackend:
+    """Resolve the estimator-level ``backend=`` argument.
+
+    Accepts a ``ProjectionBackend`` instance (passed through), a registry
+    key, or ``'auto'`` — which prefers ``'jax'`` when jax imports cleanly and
+    falls back to ``'numpy'`` otherwise.
+    """
+    if isinstance(backend, ProjectionBackend):
+        return backend
+    if backend == "auto":
+        try:
+            return get_backend("jax", **options)
+        except ImportError:
+            return get_backend("numpy", **options)
+    return get_backend(backend, **options)
+
+
+def _ensure_builtin_backends() -> None:
+    # Deferred so `import randomprojection_tpu` stays jax-free: the numpy
+    # backend registers eagerly here; 'jax' registers a lazy factory that
+    # imports jax only when actually requested.
+    if "numpy" not in _REGISTRY:
+        from randomprojection_tpu.backends.numpy_backend import NumpyBackend
+
+        register_backend("numpy", NumpyBackend)
+    if "jax" not in _REGISTRY:
+
+        def _jax_factory(**options):
+            from randomprojection_tpu.backends.jax_backend import JaxBackend
+
+            return JaxBackend(**options)
+
+        register_backend("jax", _jax_factory)

@@ -1,0 +1,179 @@
+"""JAX/TPU backend — the jit-compiled XLA execution path (layer L4 → L1).
+
+This is the backend the whole framework exists for (``BASELINE.json:5``):
+the projection matrix is generated **on device** with counter-based
+``jax.random`` (never transferred from host), and ``transform`` is a
+jit-compiled einsum ``X @ R.T`` that runs on the MXU.
+
+TPU-first decisions
+-------------------
+- **Compute dtype is float32 by default** (``bfloat16`` available via
+  ``compute_dtype=``).  TPUs have no fast f64; a spec with ``dtype=float64``
+  is *executed* in f32 and the output cast on the way out.  Cross-backend
+  parity is therefore defined at the pairwise-distance-distortion level
+  (target ≤1e-3, ``BASELINE.json:5``), not bitwise — SURVEY.md §8.
+- **Sparse kernels are dense on device.**  The MXU consumes dense tiles; a
+  k×d matrix is small (256×4096 f32 = 4 MiB).  Sparse *inputs* X are
+  densified per batch.  ``dense_output`` is honored trivially (always dense).
+- **Static shapes for XLA.**  Batches are row-padded up to a bucket (next
+  power of two, min 8) so a streaming loop with ragged tails compiles O(log n)
+  programs, not one per batch shape.
+- **Sharding-ready.**  Pass ``mesh=`` (a ``jax.sharding.Mesh``) and the
+  backend places R replicated and shards batch rows over ``data_axis``; XLA
+  inserts any needed collectives.  Same code, 1 chip or a pod slice
+  (SURVEY.md §3.3 DP row-parallelism — the Spark map-over-partitions
+  equivalent, with ICI broadcast replacing driver→executor RPC).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from randomprojection_tpu.backends.base import ProjectionBackend, ProjectionSpec
+
+__all__ = ["JaxBackend"]
+
+
+def _pad_rows(n: int) -> int:
+    """Bucket a row count to bound jit recompiles: next power of two, ≥ 8."""
+    return max(8, 1 << (n - 1).bit_length())
+
+
+class JaxBackend(ProjectionBackend):
+    """XLA executor: device-resident R, jit einsum transform."""
+
+    name = "jax"
+
+    def __init__(
+        self,
+        *,
+        compute_dtype: str = "float32",
+        mesh: Optional[object] = None,
+        data_axis: str = "data",
+        feature_axis: Optional[str] = None,
+    ):
+        import jax  # deferred: `backend='numpy'` must never import jax
+
+        self._jax = jax
+        self.compute_dtype = compute_dtype
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.feature_axis = feature_axis
+        self._transform_fn = None
+
+    # -- sharding helpers ---------------------------------------------------
+
+    def _replicated_sharding(self):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _row_sharding(self):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec(self.data_axis))
+
+    # -- ProjectionBackend API ----------------------------------------------
+
+    def materialize(self, spec: ProjectionSpec):
+        import jax
+        import jax.numpy as jnp
+
+        from randomprojection_tpu.ops import kernels
+
+        key = jax.random.key(spec.seed)
+        dtype = jnp.dtype(self.compute_dtype)
+        if spec.kind == "gaussian":
+            R = kernels.gaussian_matrix(key, spec.n_components, spec.n_features, dtype)
+        elif spec.kind == "sparse":
+            R = kernels.sparse_matrix(
+                key, spec.n_components, spec.n_features, float(spec.density), dtype
+            )
+        elif spec.kind == "rademacher":
+            R = kernels.rademacher_matrix(
+                key, spec.n_components, spec.n_features, dtype
+            )
+        else:  # pragma: no cover - spec validates kind
+            raise ValueError(spec.kind)
+        sharding = self._replicated_sharding()
+        if sharding is not None:
+            R = jax.device_put(R, sharding)
+        return R
+
+    def _get_transform_fn(self):
+        if self._transform_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def _project(x, r):
+                # einsum 'nd,kd->nk' — one MXU contraction per batch.
+                # f32 accumulation even for bf16 inputs (MXU native); the
+                # output is cast to the spec dtype only at the host edge.
+                y = jnp.einsum("nd,kd->nk", x, r, preferred_element_type=jnp.float32)
+                return y.astype(x.dtype)
+
+            self._transform_fn = _project
+        return self._transform_fn
+
+    def transform(self, X, state, spec: ProjectionSpec, *, dense_output: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        device_resident = isinstance(X, jax.Array)
+        if sp.issparse(X):
+            X = X.toarray()
+
+        if device_resident:
+            x = X.astype(jnp.dtype(self.compute_dtype))
+            n = x.shape[0]
+        else:
+            X = np.asarray(X)
+            n = X.shape[0]
+            x = np.ascontiguousarray(X, dtype=self.compute_dtype)
+
+        pad_to = _pad_rows(n)
+        if pad_to != n:
+            x = jnp.pad(x, ((0, pad_to - n), (0, 0))) if device_resident else np.pad(
+                x, ((0, pad_to - n), (0, 0))
+            )
+        row_sharding = self._row_sharding()
+        if not device_resident or row_sharding is not None:
+            x = jax.device_put(x, row_sharding)
+
+        y = self._get_transform_fn()(x, state)
+        y = y[:n] if pad_to != n else y
+
+        if device_resident:
+            return y
+        return np.asarray(y).astype(spec.np_dtype, copy=False)
+
+    def inverse_components(self, state, spec: ProjectionSpec) -> np.ndarray:
+        import jax.numpy as jnp
+
+        # XLA SVD on the small (k, d) matrix; host copy for serialization
+        return np.asarray(jnp.linalg.pinv(state.astype(jnp.float32)))
+
+    def inverse_transform(self, Y, inverse_components, spec: ProjectionSpec):
+        import jax
+        import jax.numpy as jnp
+
+        device_resident = isinstance(Y, jax.Array)
+        if sp.issparse(Y):
+            Y = Y.toarray()
+        y = jnp.asarray(Y, dtype=jnp.dtype(self.compute_dtype))
+        inv = jnp.asarray(inverse_components, dtype=jnp.dtype(self.compute_dtype))
+        x = jax.jit(lambda a, b: a @ b.T)(y, inv)
+        if device_resident:
+            return x
+        return np.asarray(x).astype(spec.np_dtype, copy=False)
+
+    def components_to_numpy(self, state, spec: ProjectionSpec):
+        return np.asarray(state).astype(spec.np_dtype, copy=False)

@@ -1,0 +1,72 @@
+"""Host NumPy/SciPy backend — the CPU reference executor and parity oracle.
+
+This is the reference's ``backend='numpy'`` path (``BASELINE.json:5``):
+dense BLAS GEMM for Gaussian, scipy CSR SpMM for the sparse kernel
+(call-site contract ``random_projection.py:613`` and ``:825-827``).
+The jax backend's outputs are validated against this one at the
+distance-distortion level (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from randomprojection_tpu.backends.base import ProjectionBackend, ProjectionSpec
+from randomprojection_tpu.ops.numpy_kernels import (
+    gaussian_random_matrix,
+    rademacher_random_matrix,
+    sparse_random_matrix,
+)
+
+__all__ = ["NumpyBackend"]
+
+
+class NumpyBackend(ProjectionBackend):
+    """Single-host CPU executor: ndarray / CSR state, BLAS matmuls."""
+
+    name = "numpy"
+
+    def materialize(self, spec: ProjectionSpec):
+        rng = np.random.default_rng(spec.seed)
+        if spec.kind == "gaussian":
+            R = gaussian_random_matrix(spec.n_components, spec.n_features, rng)
+        elif spec.kind == "sparse":
+            R = sparse_random_matrix(
+                spec.n_components, spec.n_features, density=spec.density, rng=rng
+            )
+        elif spec.kind == "rademacher":
+            R = rademacher_random_matrix(spec.n_components, spec.n_features, rng)
+        else:  # pragma: no cover - spec validates kind
+            raise ValueError(spec.kind)
+        if sp.issparse(R):
+            return R.astype(spec.np_dtype)
+        return np.ascontiguousarray(R, dtype=spec.np_dtype)
+
+    def transform(self, X, state, spec: ProjectionSpec, *, dense_output: bool = True):
+        # scipy semantics (random_projection.py:825-827 via safe_sparse_dot):
+        # output is sparse only if X is sparse AND dense_output=False.
+        if sp.issparse(X):
+            Y = X @ state.T
+            if dense_output and sp.issparse(Y):
+                Y = Y.toarray()
+            return Y
+        X = np.asarray(X)
+        if sp.issparse(state):
+            # dense X · sparse Rᵀ: compute (R · Xᵀ)ᵀ so the CSR matmul drives
+            Y = (state @ X.T).T
+            return np.ascontiguousarray(Y)
+        return X @ state.T
+
+    def inverse_components(self, state, spec: ProjectionSpec) -> np.ndarray:
+        # pinv of the densified (k, d) matrix (random_projection.py:360-365)
+        R = state.toarray() if sp.issparse(state) else np.asarray(state)
+        return np.linalg.pinv(R)  # shape (d, k)
+
+    def inverse_transform(self, Y, inverse_components, spec: ProjectionSpec):
+        if sp.issparse(Y):
+            Y = Y.toarray()
+        return np.asarray(Y) @ inverse_components.T
+
+    def components_to_numpy(self, state, spec: ProjectionSpec):
+        return state

@@ -1,0 +1,18 @@
+"""Model families (layer L5): the user-facing estimator API.
+
+Classic JL projections (``base``, ``projections``) plus the structured-RP
+siblings (``sketch``: sign-RP/SimHash, Count-Sketch) — SURVEY.md §1 configs
+1–5.
+"""
+
+from randomprojection_tpu.models.base import BaseRandomProjection
+from randomprojection_tpu.models.projections import (
+    GaussianRandomProjection,
+    SparseRandomProjection,
+)
+
+__all__ = [
+    "BaseRandomProjection",
+    "GaussianRandomProjection",
+    "SparseRandomProjection",
+]

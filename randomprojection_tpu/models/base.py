@@ -1,0 +1,250 @@
+"""``BaseRandomProjection`` — shared fit/transform machinery (layer L5).
+
+Behavioral contract: sklearn ``BaseRandomProjection``
+(``random_projection.py:308-468``), the canonical implementation of the
+reference's estimator surface (SURVEY.md §0-§1).  Key semantics preserved:
+
+- ``fit`` uses only ``X.shape`` and dtype, never the values
+  (``random_projection.py:373-376``) — so ``fit_schema(n, d)`` fits with no
+  data at all, which is what the streaming/distributed path uses.
+- ``n_components='auto'`` resolves via the JL bound; raises when the bound
+  exceeds ``n_features`` (``:403-409``); a user-fixed ``k > d`` warns
+  ``DataDimensionalityWarning`` (``:410-418``).
+- Dtype policy: f32→f32, f64→f64, ints promote to f64 (``:386-387``).
+- Determinism: same seed ⇒ identical matrix and outputs within a backend
+  (``test_random_projection.py:373-383``).
+
+What the reference does *not* have: the ``backend=`` execution seam is
+threaded through every operation (``BASELINE.json:5``), and a fitted model
+serializes as its ``ProjectionSpec`` (seed + shape + kind), so checkpoints
+are a few hundred bytes and backend-portable (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import numbers
+import warnings
+from typing import Optional
+
+import numpy as np
+
+from randomprojection_tpu.backends.base import ProjectionSpec, resolve_backend
+from randomprojection_tpu.jl import johnson_lindenstrauss_min_dim
+from randomprojection_tpu.utils.validation import (
+    DataDimensionalityWarning,
+    NotFittedError,
+    check_array,
+    resolve_transform_dtype,
+)
+
+__all__ = ["BaseRandomProjection"]
+
+
+def _resolve_seed(random_state) -> int:
+    """Collapse ``random_state`` to one int seed — the only RNG state kept.
+
+    ``None`` draws fresh OS entropy (so refits differ, like an unseeded
+    reference run) but the *drawn* seed is stored, keeping every fitted
+    model exactly reproducible and serializable.
+    """
+    if random_state is None:
+        return int(np.random.SeedSequence().generate_state(1)[0])
+    if isinstance(random_state, numbers.Integral):
+        return int(random_state)
+    if isinstance(random_state, np.random.Generator):
+        return int(random_state.integers(0, 2**31 - 1))
+    if isinstance(random_state, np.random.RandomState):
+        return int(random_state.randint(0, 2**31 - 1))
+    raise ValueError(
+        f"random_state must be None, an int, or a numpy Generator/RandomState; "
+        f"got {random_state!r}"
+    )
+
+
+class BaseRandomProjection:
+    """Shared estimator machinery; subclasses define the matrix kind.
+
+    Parameters (the reference's kwargs surface, kept fixed per BASELINE.json:5)
+    ----------
+    n_components : int or 'auto'
+    eps : float in (0, 1) — JL distortion bound used by ``'auto'``
+    compute_inverse_components : bool — precompute ``pinv(R)`` at fit
+    random_state : None | int | np.random.Generator | np.random.RandomState
+    backend : 'auto' | 'numpy' | 'jax' | ProjectionBackend instance
+    backend_options : dict — forwarded to the backend factory
+    """
+
+    #: subclasses set: 'gaussian' | 'sparse' | 'rademacher'
+    _kind: str = ""
+
+    def __init__(
+        self,
+        n_components="auto",
+        *,
+        eps: float = 0.1,
+        compute_inverse_components: bool = False,
+        random_state=None,
+        backend="auto",
+        backend_options: Optional[dict] = None,
+    ):
+        self.n_components = n_components
+        self.eps = eps
+        self.compute_inverse_components = compute_inverse_components
+        self.random_state = random_state
+        self.backend = backend
+        self.backend_options = backend_options
+
+    # -- subclass hooks ------------------------------------------------------
+
+    def _resolve_density(self, n_features: int) -> Optional[float]:
+        """Numeric density for sparse kinds; None otherwise."""
+        return None
+
+    # -- fitting -------------------------------------------------------------
+
+    def _resolve_n_components(self, n_samples: int, n_features: int) -> int:
+        if self.n_components == "auto":
+            k = johnson_lindenstrauss_min_dim(n_samples, eps=self.eps)
+            if k <= 0:
+                raise ValueError(
+                    f"eps={self.eps} and n_samples={n_samples} lead to a target "
+                    f"dimension of {k} which is invalid"
+                )
+            if k > n_features:
+                raise ValueError(
+                    f"eps={self.eps} and n_samples={n_samples} lead to a target "
+                    f"dimension of {k} which is larger than the original space "
+                    f"with n_features={n_features}"
+                )
+            return int(k)
+        if not isinstance(self.n_components, numbers.Integral) or isinstance(
+            self.n_components, bool
+        ):
+            raise ValueError(
+                f"n_components must be an int or 'auto', got {self.n_components!r}"
+            )
+        if self.n_components <= 0:
+            raise ValueError(
+                f"n_components must be strictly positive, got {self.n_components}"
+            )
+        if self.n_components > n_features:
+            warnings.warn(
+                f"The number of components is higher than the number of features: "
+                f"n_features < n_components ({n_features} < {self.n_components}). "
+                "The dimensionality of the problem will not be reduced.",
+                DataDimensionalityWarning,
+            )
+        return int(self.n_components)
+
+    def fit_schema(self, n_samples: int, n_features: int, dtype=np.float64):
+        """Fit from shape/dtype alone — no data touched.
+
+        The reference's fit reads only ``X.shape`` (SURVEY.md §4.1), so this
+        is the primitive; ``fit(X)`` delegates here.  This is how streaming
+        sources fit: pass the source's schema, never materialize rows.
+        """
+        if n_samples <= 0:
+            raise ValueError(f"n_samples must be strictly positive, got {n_samples}")
+        if n_features <= 0:
+            raise ValueError(f"n_features must be strictly positive, got {n_features}")
+
+        self._backend = resolve_backend(self.backend, **(self.backend_options or {}))
+        k = self._resolve_n_components(n_samples, n_features)
+        density = self._resolve_density(n_features)
+        out_dtype = resolve_transform_dtype(dtype)
+        seed = _resolve_seed(self.random_state)
+
+        self.spec_ = ProjectionSpec(
+            kind=self._kind,
+            n_components=k,
+            n_features=n_features,
+            seed=seed,
+            density=density,
+            dtype=out_dtype.name,
+        )
+        self.n_components_ = k
+        self.n_features_in_ = n_features
+        if density is not None:
+            self.density_ = density
+        self._state = self._backend.materialize(self.spec_)
+        if self.compute_inverse_components:
+            self.inverse_components_ = self._backend.inverse_components(
+                self._state, self.spec_
+            )
+        return self
+
+    def fit(self, X, y=None):
+        """Materialize the projection matrix sized to ``X``'s shape."""
+        X = check_array(X, accept_sparse=True)
+        n_samples, n_features = X.shape
+        return self.fit_schema(n_samples, n_features, dtype=X.dtype)
+
+    # -- inference -----------------------------------------------------------
+
+    def _check_is_fitted(self):
+        if not hasattr(self, "spec_"):
+            raise NotFittedError(
+                f"This {type(self).__name__} instance is not fitted yet. "
+                "Call 'fit' with appropriate arguments before using this estimator."
+            )
+
+    def _validate_for_transform(self, X, n_expected: int, what: str):
+        shape = getattr(X, "shape", None)
+        if shape is None or len(shape) != 2:
+            X = check_array(X, accept_sparse=True)
+            shape = X.shape
+        if shape[1] != n_expected:
+            raise ValueError(
+                f"X has {shape[1]} features, but {type(self).__name__} was fitted "
+                f"expecting {n_expected} {what}"
+            )
+        return X
+
+    def transform(self, X):
+        """Project one batch: ``X @ R.T`` via the selected backend."""
+        self._check_is_fitted()
+        X = self._validate_for_transform(X, self.n_features_in_, "features")
+        return self._backend.transform(
+            X, self._state, self.spec_, dense_output=self._dense_output()
+        )
+
+    def fit_transform(self, X, y=None):
+        return self.fit(X).transform(X)
+
+    def inverse_transform(self, Y):
+        """Reconstruct ``X̂ = Y @ pinv(R).T`` (``random_projection.py:435-462``)."""
+        self._check_is_fitted()
+        Y = self._validate_for_transform(Y, self.n_components_, "components")
+        inv = getattr(self, "inverse_components_", None)
+        if inv is None:
+            inv = self._backend.inverse_components(self._state, self.spec_)
+        return self._backend.inverse_transform(Y, inv, self.spec_)
+
+    def _dense_output(self) -> bool:
+        return True
+
+    # -- introspection / persistence ------------------------------------------
+
+    @property
+    def components_(self):
+        """The projection matrix in backend-native form, shape ``(k, d)``."""
+        self._check_is_fitted()
+        return self._state
+
+    def components_as_numpy(self):
+        """Host copy of R (ndarray, or CSR for the numpy sparse kind)."""
+        self._check_is_fitted()
+        return self._backend.components_to_numpy(self._state, self.spec_)
+
+    def get_params(self) -> dict:
+        return {
+            "n_components": self.n_components,
+            "eps": self.eps,
+            "compute_inverse_components": self.compute_inverse_components,
+            "random_state": self.random_state,
+            "backend": self.backend if isinstance(self.backend, str) else "custom",
+        }
+
+    def __repr__(self):
+        params = ", ".join(f"{k}={v!r}" for k, v in self.get_params().items())
+        return f"{type(self).__name__}({params})"
