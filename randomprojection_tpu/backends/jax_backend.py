@@ -51,18 +51,25 @@ class JaxBackend(ProjectionBackend):
         self,
         *,
         compute_dtype: str = "float32",
+        precision: Optional[str] = None,
         mesh: Optional[object] = None,
         data_axis: str = "data",
         feature_axis: Optional[str] = None,
     ):
         import jax  # deferred: `backend='numpy'` must never import jax
 
+        from randomprojection_tpu.ops.precision import default_matmul_precision
+
         self._jax = jax
         self.compute_dtype = compute_dtype
+        if precision is None:
+            precision = default_matmul_precision(compute_dtype)
+        self.precision = precision
         self.mesh = mesh
         self.data_axis = data_axis
         self.feature_axis = feature_axis
         self._transform_fn = None
+        self._inverse_fn = None
 
     # -- sharding helpers ---------------------------------------------------
 
@@ -112,12 +119,20 @@ class JaxBackend(ProjectionBackend):
             import jax
             import jax.numpy as jnp
 
+            precision = self.precision
+
             @jax.jit
             def _project(x, r):
                 # einsum 'nd,kd->nk' — one MXU contraction per batch.
                 # f32 accumulation even for bf16 inputs (MXU native); the
                 # output is cast to the spec dtype only at the host edge.
-                y = jnp.einsum("nd,kd->nk", x, r, preferred_element_type=jnp.float32)
+                y = jnp.einsum(
+                    "nd,kd->nk",
+                    x,
+                    r,
+                    preferred_element_type=jnp.float32,
+                    precision=precision,
+                )
                 return y.astype(x.dtype)
 
             self._transform_fn = _project
@@ -170,7 +185,18 @@ class JaxBackend(ProjectionBackend):
             Y = Y.toarray()
         y = jnp.asarray(Y, dtype=jnp.dtype(self.compute_dtype))
         inv = jnp.asarray(inverse_components, dtype=jnp.dtype(self.compute_dtype))
-        x = jax.jit(lambda a, b: a @ b.T)(y, inv)
+        if self._inverse_fn is None:
+            precision = self.precision
+
+            @jax.jit
+            def _reconstruct(a, b):
+                return jnp.einsum(
+                    "nk,dk->nd", a, b,
+                    preferred_element_type=jnp.float32, precision=precision,
+                ).astype(a.dtype)
+
+            self._inverse_fn = _reconstruct
+        x = self._inverse_fn(y, inv)
         if device_resident:
             return x
         return np.asarray(x).astype(spec.np_dtype, copy=False)

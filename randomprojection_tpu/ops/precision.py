@@ -1,0 +1,18 @@
+"""The one dtype→MXU-precision policy, shared by every einsum site.
+
+On TPU, f32 matmuls default to single-pass bf16, whose pairwise-distance
+distortion (~1.6e-3 measured) exceeds the 1e-3 budget of BASELINE.json:5.
+So f32 compute gets 'high' (3-pass bf16, ~2e-5 distortion at ~1/3 peak);
+bf16 compute keeps 'default' — its inputs are already quantized, extra
+passes buy nothing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["default_matmul_precision"]
+
+
+def default_matmul_precision(dtype) -> str:
+    return "high" if np.dtype(dtype) == np.float32 else "default"

@@ -1,0 +1,32 @@
+"""Parallelism layer: mesh management and sharded execution (SURVEY.md §3.3).
+
+The reference's only strategy is data parallelism over rows (Spark map over
+partitions).  Here that is the 1-D ``'data'`` mesh axis; an optional
+``'feature'`` axis adds tensor-parallel sharding of the contraction
+dimension ``d`` with a ``psum`` reduce — the structural analog of
+sequence/context parallelism for this workload (SURVEY.md §6
+"long-context").  All communication is XLA collectives over ICI/DCN; there
+is no hand-written networking (SURVEY.md §3.4).
+"""
+
+from randomprojection_tpu.parallel.mesh import (
+    default_mesh,
+    make_mesh,
+    mesh_shape_for,
+)
+from randomprojection_tpu.parallel.sharded import (
+    make_sharded_projector,
+    materialize_sharded,
+    replicated,
+    row_sharded,
+)
+
+__all__ = [
+    "default_mesh",
+    "make_mesh",
+    "mesh_shape_for",
+    "make_sharded_projector",
+    "materialize_sharded",
+    "replicated",
+    "row_sharded",
+]

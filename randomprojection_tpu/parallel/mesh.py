@@ -1,0 +1,61 @@
+"""Device-mesh construction helpers.
+
+TPU mapping (SURVEY.md §3.4): multi-host bring-up is
+``jax.distributed.initialize()`` + one process per host; the mesh spans all
+chips and XLA routes collectives over ICI within a slice and DCN across
+slices.  Mesh axes used by this framework:
+
+- ``'data'``   — row parallelism (the reference's Spark partition map)
+- ``'feature'`` — optional contraction-dim (d) sharding with psum (TP)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+__all__ = ["make_mesh", "default_mesh", "mesh_shape_for"]
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def make_mesh(axis_sizes: dict, devices: Optional[Sequence] = None):
+    """Build a ``jax.sharding.Mesh`` from ``{axis_name: size}``.
+
+    Sizes must multiply to the device count (pass ``devices`` to use a
+    subset).  Axis order follows dict order; put the fastest-varying
+    (innermost-ICI) axis last.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    total = 1
+    for s in axis_sizes.values():
+        total *= s
+    if total != len(devices):
+        raise ValueError(
+            f"Mesh axes {axis_sizes} require {total} devices, have {len(devices)}"
+        )
+    return jax.make_mesh(
+        tuple(axis_sizes.values()), tuple(axis_sizes.keys()), devices=devices
+    )
+
+
+def mesh_shape_for(n_devices: int, feature_shards: int = 1) -> dict:
+    """Default mesh factorization: all devices on 'data' unless TP requested."""
+    if n_devices % feature_shards:
+        raise ValueError(
+            f"feature_shards={feature_shards} must divide n_devices={n_devices}"
+        )
+    shape = {DATA_AXIS: n_devices // feature_shards}
+    if feature_shards > 1:
+        shape[FEATURE_AXIS] = feature_shards
+    return shape
+
+
+def default_mesh(n_devices: Optional[int] = None, feature_shards: int = 1):
+    """A ready mesh over the first ``n_devices`` devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return make_mesh(mesh_shape_for(len(devices), feature_shards), devices)

@@ -1,0 +1,123 @@
+"""Sharded projection execution: DP over rows, optional TP over features.
+
+The compute is one contraction, ``Y[n,k] = Σ_d X[n,d]·R[k,d]``.  Shardings:
+
+- **DP (default)**: X row-sharded over ``'data'``, R replicated, Y
+  row-sharded.  Zero collectives in steady state — the Spark map-over-
+  partitions equivalent (SURVEY.md §3.3).
+- **DP×TP**: X sharded ``(data, feature)``, R column-sharded over
+  ``'feature'``; each chip computes a partial ``X_shard @ R_shardᵀ`` and a
+  single ``psum`` over ``'feature'`` completes the contraction.  This is
+  the contraction-dim sharding used when ``d`` is too large for one chip's
+  HBM slice (configs 3–4, SURVEY.md §1) — ring-attention-style blockwise
+  accumulation without attention (SURVEY.md §6 "long-context").
+
+PRNG under sharding: ``jax.random`` is counter-based (threefry) and JAX's
+partitionable-PRNG mode makes generation sharding-invariant, so
+``materialize_sharded`` produces bit-identical values to single-device
+materialization while each chip only ever touches its own shard.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from randomprojection_tpu.ops.precision import default_matmul_precision
+from randomprojection_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS
+
+__all__ = [
+    "replicated",
+    "row_sharded",
+    "feature_sharded",
+    "materialize_sharded",
+    "make_sharded_projector",
+]
+
+
+def replicated(mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def row_sharded(mesh, data_axis: str = DATA_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, P(data_axis, None))
+
+
+def feature_sharded(mesh, feature_axis: str = FEATURE_AXIS) -> NamedSharding:
+    """R column-sharded: each chip holds R[:, d_shard]."""
+    return NamedSharding(mesh, P(None, feature_axis))
+
+
+def materialize_sharded(
+    matrix_fn,
+    key,
+    n_components: int,
+    n_features: int,
+    mesh,
+    *,
+    feature_axis: Optional[str] = None,
+    dtype=jnp.float32,
+):
+    """Materialize R directly into its mesh layout.
+
+    ``matrix_fn`` is one of ``ops.kernels.{gaussian,sparse,rademacher}_matrix``
+    (already jitted).  With ``feature_axis`` set, XLA partitions the
+    counter-based generation so each chip computes only its column shard —
+    values identical to the unsharded matrix.
+    """
+    sharding = (
+        feature_sharded(mesh, feature_axis) if feature_axis else replicated(mesh)
+    )
+    fn = jax.jit(
+        lambda k: matrix_fn(k, n_components, n_features, dtype),
+        out_shardings=sharding,
+    )
+    return fn(key)
+
+
+def make_sharded_projector(
+    mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    feature_axis: Optional[str] = None,
+    accum_dtype=jnp.float32,
+    precision: Optional[str] = None,
+):
+    """Build the jitted sharded transform ``(X, R) -> X @ R.T``.
+
+    Returns a function expecting X laid out ``P(data, feature)`` (or
+    ``P(data, None)`` without TP) and R laid out ``P(None, feature)`` /
+    replicated.  Inputs not already on the mesh are placed by the ``jit``
+    in/out shardings.
+    """
+    if feature_axis is None:
+        in_specs = (P(data_axis, None), P())
+        out_specs = P(data_axis, None)
+
+        def local(x, r):
+            prec = precision or default_matmul_precision(x.dtype)
+            y = jnp.einsum(
+                "nd,kd->nk", x, r,
+                preferred_element_type=accum_dtype, precision=prec,
+            )
+            return y.astype(x.dtype)
+
+    else:
+        in_specs = (P(data_axis, feature_axis), P(None, feature_axis))
+        out_specs = P(data_axis, None)
+
+        def local(x, r):
+            prec = precision or default_matmul_precision(x.dtype)
+            partial = jnp.einsum(
+                "nd,kd->nk", x, r,
+                preferred_element_type=accum_dtype, precision=prec,
+            )
+            # one ICI all-reduce completes the contraction over sharded d
+            y = jax.lax.psum(partial, feature_axis)
+            return y.astype(x.dtype)
+
+    sharded = jax.shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return jax.jit(sharded)

@@ -10,7 +10,14 @@ import os
 import sys
 
 # Must happen before any jax import (jax reads these at first import).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the environment pre-sets JAX_PLATFORMS to the real TPU
+# platform, but the test suite runs on a virtual 8-device CPU mesh; set
+# RP_TEST_TPU=1 to run the suite against the real chip instead.
+if os.environ.get("RP_TEST_TPU", "") in ("", "0"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _FORCE_CPU = True
+else:
+    _FORCE_CPU = False
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -23,6 +30,13 @@ if REPO_ROOT not in sys.path:
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+if _FORCE_CPU:
+    # The environment pre-registers an out-of-tree TPU platform plugin that
+    # wins over the JAX_PLATFORMS env var; the config knob reliably pins CPU.
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture
