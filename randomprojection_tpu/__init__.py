@@ -24,6 +24,10 @@ _LAZY_ESTIMATORS = (
     "BaseRandomProjection",
     "GaussianRandomProjection",
     "SparseRandomProjection",
+    "SignRandomProjection",
+    "CountSketch",
+    "pairwise_hamming",
+    "cosine_from_hamming",
 )
 
 __all__ = [

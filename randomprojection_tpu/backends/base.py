@@ -104,6 +104,18 @@ class ProjectionBackend(abc.ABC):
         ``random_projection.py:825-827``); dense-only backends may ignore it.
         """
 
+    def transform_async(
+        self, X, state: Any, spec: ProjectionSpec, *, dense_output: bool = True
+    ):
+        """Like ``transform`` but may return a lazy/device-resident handle.
+
+        Used by the streaming pipeline: the returned handle is materialized
+        later (``numpy.asarray``), letting async backends overlap the next
+        batch's transfer+compute with this batch's fetch.  Synchronous
+        backends just return ``transform``'s result.
+        """
+        return self.transform(X, state, spec, dense_output=dense_output)
+
     @abc.abstractmethod
     def inverse_components(self, state: Any, spec: ProjectionSpec) -> np.ndarray:
         """Moore–Penrose pseudo-inverse of R, shape ``(d, k)``."""

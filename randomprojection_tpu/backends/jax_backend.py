@@ -70,6 +70,7 @@ class JaxBackend(ProjectionBackend):
         self.feature_axis = feature_axis
         self._transform_fn = None
         self._inverse_fn = None
+        self._sign_fn = None
 
     # -- sharding helpers ---------------------------------------------------
 
@@ -139,6 +140,24 @@ class JaxBackend(ProjectionBackend):
         return self._transform_fn
 
     def transform(self, X, state, spec: ProjectionSpec, *, dense_output: bool = True):
+        y, device_resident = self._transform_impl(X, state)
+        if device_resident:
+            return y
+        return np.asarray(y).astype(spec.np_dtype, copy=False)
+
+    def transform_async(
+        self, X, state, spec: ProjectionSpec, *, dense_output: bool = True
+    ):
+        # device-resident handle either way; the stream pipeline fetches it
+        # later, overlapping with the next batch's dispatch
+        y, _ = self._transform_impl(X, state)
+        return y
+
+    def _prepare_rows(self, X):
+        """Shared batch preamble: densify, cast, row-bucket pad, shard, place.
+
+        Returns ``(x_on_device, n_real_rows, device_resident)``.
+        """
         import jax
         import jax.numpy as jnp
 
@@ -156,19 +175,48 @@ class JaxBackend(ProjectionBackend):
 
         pad_to = _pad_rows(n)
         if pad_to != n:
-            x = jnp.pad(x, ((0, pad_to - n), (0, 0))) if device_resident else np.pad(
-                x, ((0, pad_to - n), (0, 0))
-            )
+            pad = ((0, pad_to - n), (0, 0))
+            x = jnp.pad(x, pad) if device_resident else np.pad(x, pad)
         row_sharding = self._row_sharding()
         if not device_resident or row_sharding is not None:
             x = jax.device_put(x, row_sharding)
+        return x, n, device_resident
 
+    def _transform_impl(self, X, state):
+        x, n, device_resident = self._prepare_rows(X)
         y = self._get_transform_fn()(x, state)
-        y = y[:n] if pad_to != n else y
+        return y[:n], device_resident
 
-        if device_resident:
+    def transform_packed_signs(
+        self, X, state, spec: ProjectionSpec, *, materialize: bool = True
+    ):
+        """Fused SimHash path: einsum → sign → packbits, all on device.
+
+        Output is ``(n, ceil(k/8))`` uint8 — shrinking the d2h transfer 32×
+        vs f32 coordinates (the point of config 4's 1B-row workload).
+        ``materialize=False`` returns the device handle (streaming pipeline).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self._sign_fn is None:
+            precision = self.precision
+
+            @jax.jit
+            def _sign_project(x, r):
+                y = jnp.einsum(
+                    "nd,kd->nk", x, r,
+                    preferred_element_type=jnp.float32, precision=precision,
+                )
+                return jnp.packbits(y > 0, axis=-1, bitorder="little")
+
+            self._sign_fn = _sign_project
+
+        x, n, device_resident = self._prepare_rows(X)
+        y = self._sign_fn(x, state)[:n]
+        if device_resident or not materialize:
             return y
-        return np.asarray(y).astype(spec.np_dtype, copy=False)
+        return np.asarray(y)
 
     def inverse_components(self, state, spec: ProjectionSpec) -> np.ndarray:
         import jax.numpy as jnp

@@ -10,9 +10,19 @@ from randomprojection_tpu.models.projections import (
     GaussianRandomProjection,
     SparseRandomProjection,
 )
+from randomprojection_tpu.models.sketch import (
+    CountSketch,
+    SignRandomProjection,
+    cosine_from_hamming,
+    pairwise_hamming,
+)
 
 __all__ = [
     "BaseRandomProjection",
     "GaussianRandomProjection",
     "SparseRandomProjection",
+    "SignRandomProjection",
+    "CountSketch",
+    "pairwise_hamming",
+    "cosine_from_hamming",
 ]

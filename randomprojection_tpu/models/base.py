@@ -76,6 +76,9 @@ class BaseRandomProjection:
 
     #: subclasses set: 'gaussian' | 'sparse' | 'rademacher'
     _kind: str = ""
+    #: warn when a user-fixed k exceeds d (False for sign-RP: more bits
+    #: than input dims is normal LSH usage, not a mistake)
+    _warn_on_expand: bool = True
 
     def __init__(
         self,
@@ -127,7 +130,7 @@ class BaseRandomProjection:
             raise ValueError(
                 f"n_components must be strictly positive, got {self.n_components}"
             )
-        if self.n_components > n_features:
+        if self.n_components > n_features and self._warn_on_expand:
             warnings.warn(
                 f"The number of components is higher than the number of features: "
                 f"n_features < n_components ({n_features} < {self.n_components}). "
@@ -222,6 +225,37 @@ class BaseRandomProjection:
 
     def _dense_output(self) -> bool:
         return True
+
+    # -- streaming (layer L2) --------------------------------------------------
+
+    def _transform_async(self, X):
+        """Transform for the streaming pipeline: may return a lazy device
+        handle.  Subclasses overriding ``transform`` must override this to
+        match (it is their transform, minus eager host materialization)."""
+        self._check_is_fitted()
+        X = self._validate_for_transform(X, self.n_features_in_, "features")
+        return self._backend.transform_async(
+            X, self._state, self.spec_, dense_output=self._dense_output()
+        )
+
+    def _stream_out_dtype(self):
+        """Dtype committed stream batches are cast to (None = leave as-is)."""
+        return self.spec_.np_dtype
+
+    def fit_source(self, source):
+        """Fit from a ``RowBatchSource`` schema — zero rows materialized."""
+        n_rows, n_features, dtype = source.schema()
+        return self.fit_schema(n_rows, n_features, dtype=dtype)
+
+    def transform_stream(self, source, **kwargs):
+        """Stream-project a ``RowBatchSource``; see ``streaming.stream_transform``.
+
+        Yields ``(start_row, Y_batch)`` in row order; supports cursor
+        checkpoint/resume and double-buffered device feeding.
+        """
+        from randomprojection_tpu.streaming import stream_transform
+
+        return stream_transform(self, source, **kwargs)
 
     # -- introspection / persistence ------------------------------------------
 

@@ -1,0 +1,236 @@
+"""Structured-RP sketch family (SURVEY.md §1 configs 4–5).
+
+- ``SignRandomProjection``: SimHash cosine-LSH.  Project onto k Gaussian
+  hyperplanes, keep only sign bits, packed 8-per-byte.  Hamming distance
+  between codes estimates the angle: ``cos(θ) ≈ cos(π·hamming/k)``
+  (Charikar 2002).  Config 4's "1B×768 embeddings" workload: 256-bit codes
+  are 32 bytes/row — the d2h transfer shrinks 96× vs f32 coordinates, so
+  packing happens **on device** in the jax backend.
+- ``CountSketch``: feature-hashing projection (Charikar-Chen-Farach-Colton;
+  the dense-input analog of sklearn ``FeatureHasher`` — see
+  ``ops/hashing.py`` for the raw-token hasher).  ``Y[i, h(j)] += s(j)·X[i,j]``
+  with pairwise-independent ``h: [d]→[k]`` and sign ``s: [d]→{±1}``.
+  Unbiased: ``E[s(j)·Y[h(j)]] = x[j]``; the decode is ``inverse_transform``.
+
+Both keep the estimator surface (fit / fit_schema / transform / seeds) so
+they compose with the streaming layer and backends like the JL estimators.
+"""
+
+from __future__ import annotations
+
+import math
+import numbers
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from randomprojection_tpu.models.base import BaseRandomProjection, _resolve_seed
+from randomprojection_tpu.utils.validation import NotFittedError, check_array
+
+__all__ = [
+    "SignRandomProjection",
+    "CountSketch",
+    "pairwise_hamming",
+    "cosine_from_hamming",
+]
+
+
+class SignRandomProjection(BaseRandomProjection):
+    """SimHash: sign bits of a Gaussian projection, packed to uint8.
+
+    ``transform`` returns shape ``(n, ceil(k/8))`` uint8 codes (little-endian
+    bit order within each byte; trailing pad bits are zero for every row, so
+    they cancel in Hamming distances).  Use ``pairwise_hamming`` /
+    ``cosine_from_hamming`` on the codes.
+    """
+
+    _kind = "gaussian"  # Gaussian hyperplanes = unbiased angle estimates
+    _warn_on_expand = False  # k bits > d dims is normal LSH usage
+
+    def transform(self, X):
+        self._check_is_fitted()
+        X = self._validate_for_transform(X, self.n_features_in_, "features")
+        packed = getattr(self._backend, "transform_packed_signs", None)
+        if packed is not None:
+            return packed(X, self._state, self.spec_)
+        y = np.asarray(self._backend.transform(X, self._state, self.spec_))
+        return np.packbits(y > 0, axis=-1, bitorder="little")
+
+    def _transform_async(self, X):
+        # streaming variant of the override above: keep the packed codes as
+        # a lazy device handle where the backend supports it
+        self._check_is_fitted()
+        X = self._validate_for_transform(X, self.n_features_in_, "features")
+        packed = getattr(self._backend, "transform_packed_signs", None)
+        if packed is not None:
+            return packed(X, self._state, self.spec_, materialize=False)
+        y = np.asarray(self._backend.transform(X, self._state, self.spec_))
+        return np.packbits(y > 0, axis=-1, bitorder="little")
+
+    def _stream_out_dtype(self):
+        return np.uint8
+
+    def inverse_transform(self, Y):
+        raise NotImplementedError(
+            "Sign codes discard magnitudes; SimHash has no inverse. "
+            "Use cosine_from_hamming for similarity estimates."
+        )
+
+
+def pairwise_hamming(A, B=None):
+    """Hamming distances between packed sign codes.
+
+    ``A: (n1, nbytes)``, ``B: (n2, nbytes)`` (default ``B=A``) → ``(n1, n2)``
+    int32.  Host implementation (np.bitwise_count); for device-side bulk
+    scoring use ``ops.kernels``-style jit with ``lax.population_count``.
+    """
+    A = np.asarray(A, dtype=np.uint8)
+    B = A if B is None else np.asarray(B, dtype=np.uint8)
+    return (
+        np.bitwise_count(A[:, None, :] ^ B[None, :, :]).sum(-1).astype(np.int32)
+    )
+
+
+def cosine_from_hamming(hamming, n_bits: int):
+    """SimHash estimate: ``cos(π · hamming / k)`` (Charikar 2002)."""
+    return np.cos(np.pi * np.asarray(hamming, dtype=np.float64) / n_bits)
+
+
+class CountSketch:
+    """Count-Sketch / hashing-trick projection ``(n, d) → (n, k)``.
+
+    The hash maps ``h_`` (int32 ``[0, k)``) and signs ``s_`` (±1 int8) are
+    derived from the seed on the host — a few KB, backend-independent — so
+    numpy and jax paths produce identical sketches (unlike the JL kernels,
+    where each backend has its own PRNG; SURVEY.md §8).
+
+    Dense inputs on the jax backend use a one-hot-free device scatter-add;
+    sparse CSR inputs use a vectorized host scatter (the Cython
+    ``FeatureHasher`` fast path's role — sklearn ``_hashing_fast.pyx``).
+    """
+
+    def __init__(self, n_components, *, random_state=None, backend="auto"):
+        if not isinstance(n_components, numbers.Integral) or n_components <= 0:
+            raise ValueError(
+                f"n_components must be a positive int, got {n_components!r}"
+            )
+        self.n_components = int(n_components)
+        self.random_state = random_state
+        self.backend = backend
+
+    def fit_schema(self, n_samples: int, n_features: int, dtype=np.float64):
+        if n_features <= 0:
+            raise ValueError(f"n_features must be strictly positive, got {n_features}")
+        self.seed_ = _resolve_seed(self.random_state)
+        rng = np.random.default_rng(self.seed_)
+        self.n_components_ = self.n_components
+        self.n_features_in_ = n_features
+        self.h_ = rng.integers(0, self.n_components, size=n_features, dtype=np.int32)
+        self.s_ = (rng.integers(0, 2, size=n_features, dtype=np.int8) * 2 - 1)
+        self._use_jax = self.backend in ("jax", "auto") and _jax_available()
+        return self
+
+    def fit(self, X, y=None):
+        X = check_array(X, accept_sparse=True)
+        return self.fit_schema(*X.shape, dtype=X.dtype)
+
+    def _check_is_fitted(self):
+        if not hasattr(self, "h_"):
+            raise NotFittedError(
+                f"This {type(self).__name__} instance is not fitted yet."
+            )
+
+    def transform(self, X):
+        self._check_is_fitted()
+        if sp.issparse(X):
+            return self._transform_csr(X.tocsr())
+        X = check_array(X, accept_sparse=False)
+        if X.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"X has {X.shape[1]} features, expected {self.n_features_in_}"
+            )
+        if self._use_jax:
+            return self._transform_dense_jax(X)
+        return self._transform_dense_np(X)
+
+    def fit_transform(self, X, y=None):
+        return self.fit(X).transform(X)
+
+    def _transform_dense_np(self, X):
+        Y = np.zeros((X.shape[0], self.n_components_), dtype=X.dtype)
+        np.add.at(Y, (slice(None), self.h_), X * self.s_)
+        return Y
+
+    def _transform_dense_jax(self, X):
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_jax_fn"):
+            k = self.n_components_
+
+            @jax.jit
+            def sketch(x, h, s):
+                signed = x * s
+                # scatter-add over the feature axis: Y[:, h[j]] += x̃[:, j]
+                y = jnp.zeros((x.shape[0], k), dtype=x.dtype)
+                return y.at[:, h].add(signed)
+
+            self._jax_fn = sketch
+        y = self._jax_fn(
+            jnp.asarray(X), jnp.asarray(self.h_), jnp.asarray(self.s_, X.dtype)
+        )
+        return np.asarray(y)
+
+    def _transform_csr(self, X):
+        if X.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"X has {X.shape[1]} features, expected {self.n_features_in_}"
+            )
+        out_dtype = X.dtype if X.dtype in (np.float32, np.float64) else np.float64
+        Y = np.zeros((X.shape[0], self.n_components_), dtype=out_dtype)
+        rows = np.repeat(
+            np.arange(X.shape[0]), np.diff(X.indptr).astype(np.int64)
+        )
+        np.add.at(
+            Y,
+            (rows, self.h_[X.indices]),
+            X.data.astype(out_dtype) * self.s_[X.indices],
+        )
+        return Y
+
+    # -- streaming composition (same protocol as BaseRandomProjection) -------
+
+    def fit_source(self, source):
+        n_rows, n_features, dtype = source.schema()
+        return self.fit_schema(n_rows, n_features, dtype=dtype)
+
+    def transform_stream(self, source, **kwargs):
+        from randomprojection_tpu.streaming import stream_transform
+
+        return stream_transform(self, source, **kwargs)
+
+    def _transform_async(self, X):
+        return self.transform(X)  # host scatter paths are synchronous
+
+    def _stream_out_dtype(self):
+        return None  # keep whatever dtype transform produced
+
+    def inverse_transform(self, Y):
+        """Unbiased decode: ``x̂[j] = s(j) · Y[:, h(j)]``."""
+        self._check_is_fitted()
+        Y = np.asarray(Y)
+        if Y.shape[1] != self.n_components_:
+            raise ValueError(
+                f"Y has {Y.shape[1]} components, expected {self.n_components_}"
+            )
+        return Y[:, self.h_] * self.s_
+
+
+def _jax_available() -> bool:
+    try:
+        import jax  # noqa: F401
+
+        return True
+    except ImportError:  # pragma: no cover
+        return False

@@ -1,0 +1,5 @@
+"""Native (C++) host-side components, built on demand with g++ + ctypes."""
+
+from randomprojection_tpu.native.build import load_murmur3
+
+__all__ = ["load_murmur3"]

@@ -1,0 +1,77 @@
+"""Build + load the native murmur3 library (g++ → .so → ctypes).
+
+No pybind11 in this image, so bindings are plain ctypes over an
+``extern "C"`` surface.  The .so is built once next to the source and
+reused; a build failure (no compiler) degrades gracefully — callers fall
+back to the pure-Python implementation in ``ops/hashing.py``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "murmur3.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_murmur3.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _compile() -> bool:
+    tmp = None
+    try:
+        # build to a temp file then atomically rename: concurrent importers
+        # never see a half-written .so
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_SO))
+        os.close(fd)
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return False
+
+
+def load_murmur3() -> Optional[ctypes.CDLL]:
+    """The bound library, or None if no compiler is available."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not _compile():
+            _build_failed = True
+            return None
+    lib = ctypes.CDLL(_SO)
+    lib.murmur3_32.restype = ctypes.c_uint32
+    lib.murmur3_32.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_uint32,
+    ]
+    lib.hash_tokens.restype = None
+    lib.hash_tokens.argtypes = [
+        ctypes.c_char_p,   # concatenated token bytes
+        ctypes.c_void_p,   # int64 offsets
+        ctypes.c_int64,    # n_tokens
+        ctypes.c_uint32,   # seed
+        ctypes.c_uint32,   # n_features
+        ctypes.c_void_p,   # int32 out_idx
+        ctypes.c_void_p,   # int8 out_sign
+    ]
+    _lib = lib
+    return _lib

@@ -1,0 +1,81 @@
+// MurmurHash3 x86_32 (Austin Appleby, public domain) + batch token hashing.
+//
+// Native host-side component for the feature-hashing path (SURVEY.md §3.2:
+// the reference's hot hashing loop is Cython/C++ — sklearn
+// `feature_extraction/_hashing_fast.pyx`; this is its C++ equivalent for
+// the TPU framework's host ingest).  Compiled by native/build.py with g++
+// into _murmur3.so and bound via ctypes (no pybind11 in this image).
+//
+// Contract (matches sklearn FeatureHasher semantics):
+//   h    = signed 32-bit murmur3 of the token bytes, seed 0
+//   idx  = |h| mod n_features
+//   sign = +1 if h >= 0 else -1        (alternate_sign)
+
+#include <cstdint>
+#include <cstring>
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+extern "C" {
+
+uint32_t murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const int64_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51u;
+  const uint32_t c2 = 0x1b873593u;
+
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, data + i * 4, 4);  // little-endian assumed (x86/ARM)
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64u;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k1 ^= static_cast<uint32_t>(tail[1]) << 8;  [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+
+  h1 ^= static_cast<uint32_t>(len);
+  return fmix32(h1);
+}
+
+// Batch: tokens concatenated in `buf`, token i = buf[offsets[i], offsets[i+1]).
+// Writes idx (|h| mod n_features) and sign (±1) per token.
+void hash_tokens(const uint8_t* buf, const int64_t* offsets, int64_t n_tokens,
+                 uint32_t seed, uint32_t n_features, int32_t* out_idx,
+                 int8_t* out_sign) {
+  for (int64_t i = 0; i < n_tokens; i++) {
+    const int64_t lo = offsets[i];
+    const int64_t len = offsets[i + 1] - lo;
+    const int32_t h = static_cast<int32_t>(murmur3_32(buf + lo, len, seed));
+    const int64_t habs = h < 0 ? -static_cast<int64_t>(h) : h;
+    out_idx[i] = static_cast<int32_t>(habs % n_features);
+    out_sign[i] = h >= 0 ? 1 : -1;
+  }
+}
+
+}  // extern "C"

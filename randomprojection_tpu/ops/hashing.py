@@ -1,0 +1,172 @@
+"""Feature hashing (the hashing trick) over raw tokens → CSR.
+
+Host-side ingest for config 5 (SURVEY.md §1: "Count-Sketch /
+feature-hashing structured RP on streaming TF-IDF").  Semantics match
+sklearn ``FeatureHasher`` (``sklearn/feature_extraction/_hash.py`` +
+``_hashing_fast.pyx``): signed 32-bit murmur3 (seed 0) of the token bytes,
+``index = |h| mod n_features``, optional alternating sign to make the
+sketch unbiased.
+
+The hot loop is the native C++ batch hasher (``native/murmur3.cpp``,
+ctypes-bound); a pure-Python murmur3 is the no-compiler fallback and the
+cross-check in tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import numbers
+import struct
+from typing import Iterable, Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from randomprojection_tpu.native.build import load_murmur3
+
+__all__ = ["murmur3_32", "hash_tokens", "FeatureHasher"]
+
+
+def _murmur3_32_py(data: bytes, seed: int = 0) -> int:
+    """Pure-Python MurmurHash3 x86_32 (fallback + test oracle)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n_blocks = len(data) // 4
+    for i in range(n_blocks):
+        (k,) = struct.unpack_from("<I", data, i * 4)
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    tail = data[n_blocks * 4 :]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def murmur3_32(data, seed: int = 0, *, signed: bool = True) -> int:
+    """MurmurHash3 x86_32 of ``data`` (str or bytes)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    lib = load_murmur3()
+    if lib is not None:
+        h = lib.murmur3_32(data, len(data), seed)
+    else:
+        h = _murmur3_32_py(data, seed)
+    if signed and h >= 2**31:
+        h -= 2**32
+    return h
+
+
+def hash_tokens(tokens: Iterable, n_features: int, seed: int = 0):
+    """Batch-hash tokens → ``(idx int32, sign int8)`` arrays.
+
+    Uses the C++ batch kernel on one concatenated buffer (one FFI call for
+    the whole batch), falling back to per-token Python hashing.
+    """
+    encoded = [
+        t.encode("utf-8") if isinstance(t, str) else bytes(t) for t in tokens
+    ]
+    n = len(encoded)
+    idx = np.empty(n, dtype=np.int32)
+    sign = np.empty(n, dtype=np.int8)
+    if n == 0:
+        return idx, sign
+
+    lib = load_murmur3()
+    if lib is not None:
+        buf = b"".join(encoded)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        lib.hash_tokens(
+            buf,
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            n,
+            seed,
+            n_features,
+            idx.ctypes.data_as(ctypes.c_void_p),
+            sign.ctypes.data_as(ctypes.c_void_p),
+        )
+    else:
+        for i, e in enumerate(encoded):
+            h = murmur3_32(e, seed)
+            idx[i] = abs(h) % n_features
+            sign[i] = 1 if h >= 0 else -1
+    return idx, sign
+
+
+class FeatureHasher:
+    """Hash raw feature tokens into a ``(n_samples, n_features)`` CSR matrix.
+
+    Input per sample (``input_type``):
+      - ``'string'``: iterable of tokens, each counts 1
+      - ``'pair'``:   iterable of ``(token, value)``
+      - ``'dict'``:   mapping ``token -> value``
+
+    ``alternate_sign=True`` (default) multiplies each value by the hash
+    sign, making downstream sketches unbiased (same role as ``s`` in
+    ``CountSketch``).
+    """
+
+    def __init__(self, n_features: int = 2**20, *, input_type: str = "dict",
+                 alternate_sign: bool = True):
+        if not isinstance(n_features, numbers.Integral) or n_features <= 0:
+            raise ValueError(f"n_features must be a positive int, got {n_features!r}")
+        if input_type not in ("dict", "pair", "string"):
+            raise ValueError(
+                f"input_type must be 'dict', 'pair' or 'string', got {input_type!r}"
+            )
+        self.n_features = int(n_features)
+        self.input_type = input_type
+        self.alternate_sign = alternate_sign
+
+    def transform(self, raw_X) -> sp.csr_array:
+        tokens: list = []
+        values: list = []
+        indptr = [0]
+        for sample in raw_X:
+            if self.input_type == "dict":
+                items = sample.items()
+            elif self.input_type == "pair":
+                items = sample
+            else:
+                items = ((tok, 1.0) for tok in sample)
+            for tok, val in items:
+                if val == 0:
+                    continue
+                tokens.append(tok)
+                values.append(val)
+            indptr.append(len(tokens))
+
+        idx, sign = hash_tokens(tokens, self.n_features)
+        data = np.asarray(values, dtype=np.float64)
+        if self.alternate_sign:
+            data = data * sign
+        mat = sp.csr_array(
+            (data, idx, np.asarray(indptr, dtype=np.int64)),
+            shape=(len(indptr) - 1, self.n_features),
+        )
+        mat.sum_duplicates()
+        return mat
+
+    fit_transform = transform
+
+    def fit(self, X=None, y=None):
+        return self

@@ -1,0 +1,240 @@
+"""Streamed row-batch transform (layer L2) with checkpoint/resume.
+
+The reference feeds ``transform`` through a "streamed row-batch iterator"
+so datasets larger than memory can be projected (``BASELINE.json:5``;
+SURVEY.md §2 L2, §4.5).  TPU-native design:
+
+- **Seekable sources.**  A ``RowBatchSource`` yields fixed-size row batches
+  *starting from any row offset*.  Fixed batch size ⇒ one XLA program for
+  the whole stream (the ragged tail reuses the backend's row-bucketing).
+- **Cursor checkpointing / elastic recovery** (SURVEY.md §6): progress is
+  just ``rows_done``.  The projection matrix is derived from the seed and
+  batches are pure functions of their row range, so a failed run resumed
+  from its cursor produces **bit-identical** output — restart-from-cursor
+  is the whole failure-recovery story, verified by fault-injection tests.
+- **Double buffering**: with the jax backend, batch ``i+1`` is dispatched
+  (host→HBM copy + einsum) while batch ``i``'s result is still being
+  fetched — JAX's async dispatch overlaps them as long as we don't force
+  materialization too early.  ``pipeline_depth`` bounds device memory
+  (depth × batch bytes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+import scipy.sparse as sp
+
+__all__ = [
+    "RowBatchSource",
+    "ArraySource",
+    "CallableSource",
+    "FaultInjectionSource",
+    "StreamCursor",
+    "stream_transform",
+]
+
+
+def _check_start_row(start_row: int, batch_rows: int, n_rows: int) -> None:
+    """Resume offsets must land on a batch boundary — or be the end of the
+    stream (a completed run's cursor equals n_rows, and re-running it must
+    yield nothing, not raise)."""
+    if start_row == n_rows:
+        return
+    if start_row % batch_rows:
+        raise ValueError(
+            f"start_row={start_row} must be a multiple of batch_rows="
+            f"{batch_rows} or n_rows={n_rows} (cursors always are)"
+        )
+
+
+class RowBatchSource:
+    """Protocol: a seekable, schema-bearing stream of row batches.
+
+    Subclasses provide ``n_rows``, ``n_features``, ``dtype`` and
+    ``iter_batches(start_row)`` yielding ``(start_row, batch)`` pairs where
+    every batch has ``batch_rows`` rows except possibly the last.  Seeking
+    by row is what makes resume exact: a resumed stream re-yields the same
+    batches with the same row offsets.
+    """
+
+    batch_rows: int
+    n_rows: int
+    n_features: int
+    dtype: np.dtype
+
+    def iter_batches(self, start_row: int = 0) -> Iterator[Tuple[int, np.ndarray]]:
+        raise NotImplementedError
+
+    def schema(self) -> Tuple[int, int, np.dtype]:
+        """(n_rows, n_features, dtype) — all that fit() needs (SURVEY.md §4.1)."""
+        return self.n_rows, self.n_features, self.dtype
+
+
+class ArraySource(RowBatchSource):
+    """In-memory ndarray/CSR source — slicing is the seek."""
+
+    def __init__(self, X, batch_rows: int = 65536):
+        if batch_rows <= 0:
+            raise ValueError(f"batch_rows must be positive, got {batch_rows}")
+        if not sp.issparse(X):
+            X = np.asarray(X)
+        if X.ndim != 2:
+            raise ValueError(f"Expected 2D input, got shape {getattr(X, 'shape', None)}")
+        self._X = X
+        self.batch_rows = batch_rows
+        self.n_rows, self.n_features = X.shape
+        self.dtype = X.dtype
+
+    def iter_batches(self, start_row: int = 0):
+        _check_start_row(start_row, self.batch_rows, self.n_rows)
+        for lo in range(start_row, self.n_rows, self.batch_rows):
+            hi = min(lo + self.batch_rows, self.n_rows)
+            yield lo, self._X[lo:hi]
+
+
+class CallableSource(RowBatchSource):
+    """Out-of-core source: ``read(lo, hi) -> (hi-lo, d) array``.
+
+    The callable abstracts any seekable storage (memory-mapped file, object
+    store with range reads, database pagination).  It must be deterministic
+    in ``(lo, hi)`` for resume to be exact.
+    """
+
+    def __init__(self, read: Callable[[int, int], np.ndarray], n_rows: int,
+                 n_features: int, dtype=np.float32, batch_rows: int = 65536):
+        if batch_rows <= 0:
+            raise ValueError(f"batch_rows must be positive, got {batch_rows}")
+        self._read = read
+        self.batch_rows = batch_rows
+        self.n_rows = n_rows
+        self.n_features = n_features
+        self.dtype = np.dtype(dtype)
+
+    def iter_batches(self, start_row: int = 0):
+        _check_start_row(start_row, self.batch_rows, self.n_rows)
+        for lo in range(start_row, self.n_rows, self.batch_rows):
+            hi = min(lo + self.batch_rows, self.n_rows)
+            batch = self._read(lo, hi)
+            if batch.shape != (hi - lo, self.n_features):
+                raise ValueError(
+                    f"Source returned shape {batch.shape} for rows [{lo},{hi}); "
+                    f"expected {(hi - lo, self.n_features)}"
+                )
+            yield lo, batch
+
+
+class FaultInjectionSource(RowBatchSource):
+    """Test wrapper: raises after yielding ``fail_after_batches`` batches.
+
+    The SURVEY.md §6 fault-injection harness: crash a stream mid-flight,
+    resume from the checkpoint cursor, assert bit-identical output.
+    """
+
+    class InjectedFault(RuntimeError):
+        pass
+
+    def __init__(self, inner: RowBatchSource, fail_after_batches: int):
+        self._inner = inner
+        self.fail_after_batches = fail_after_batches
+        self.batch_rows = inner.batch_rows
+        self.n_rows = inner.n_rows
+        self.n_features = inner.n_features
+        self.dtype = inner.dtype
+        self._armed = True
+
+    def disarm(self):
+        self._armed = False
+
+    def iter_batches(self, start_row: int = 0):
+        for i, (lo, batch) in enumerate(self._inner.iter_batches(start_row)):
+            if self._armed and i >= self.fail_after_batches:
+                raise self.InjectedFault(
+                    f"injected fault before batch {i} (row {lo})"
+                )
+            yield lo, batch
+
+
+@dataclasses.dataclass
+class StreamCursor:
+    """Resumable position in a stream; serializes to a tiny JSON file.
+
+    ``rows_done`` always lands on a batch boundary — a batch is committed
+    only after its output is materialized on the host, so a crash between
+    batches loses at most in-flight (uncommitted) work, which the resume
+    recomputes identically.
+    """
+
+    rows_done: int = 0
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": 1, "rows_done": self.rows_done}, f)
+        os.replace(tmp, path)  # atomic: a crash never leaves a torn cursor
+
+    @classmethod
+    def load(cls, path: str) -> "StreamCursor":
+        with open(path) as f:
+            d = json.load(f)
+        if d.get("version") != 1:
+            raise ValueError(f"Unsupported cursor version in {path}: {d!r}")
+        return cls(rows_done=int(d["rows_done"]))
+
+
+def stream_transform(
+    estimator,
+    source: RowBatchSource,
+    *,
+    cursor: Optional[StreamCursor] = None,
+    checkpoint_path: Optional[str] = None,
+    pipeline_depth: int = 2,
+) -> Iterator[Tuple[int, np.ndarray]]:
+    """Project a stream, yielding ``(start_row, Y_batch)`` in row order.
+
+    ``estimator`` is a fitted projection estimator (any backend).  Pass a
+    ``cursor`` (or a ``checkpoint_path`` holding one) to resume; the cursor
+    is advanced as batches are *committed* (host-materialized), and saved
+    to ``checkpoint_path`` after each commit when given.
+
+    ``pipeline_depth`` > 1 keeps that many batches in flight on the jax
+    backend (double buffering); the numpy backend is synchronous and
+    unaffected.
+    """
+    if pipeline_depth < 1:
+        raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+    if cursor is None:
+        if checkpoint_path is not None and os.path.exists(checkpoint_path):
+            cursor = StreamCursor.load(checkpoint_path)
+        else:
+            cursor = StreamCursor()
+
+    estimator._check_is_fitted()
+    out_dtype = estimator._stream_out_dtype()
+
+    pending: list = []  # [(start_row, n_rows, Y_lazy)]
+
+    def commit(entry):
+        start_row, n_rows, y = entry
+        if not sp.issparse(y):  # forces device→host for lazy handles
+            y = np.asarray(y)
+            if out_dtype is not None:
+                y = y.astype(out_dtype, copy=False)
+        cursor.rows_done = start_row + n_rows
+        if checkpoint_path is not None:
+            cursor.save(checkpoint_path)
+        return start_row, y
+
+    for start_row, batch in source.iter_batches(cursor.rows_done):
+        # _transform_async is each estimator's own (possibly overridden)
+        # transform, returning a lazy device handle where supported
+        y = estimator._transform_async(batch)
+        pending.append((start_row, batch.shape[0], y))
+        if len(pending) >= pipeline_depth:
+            yield commit(pending.pop(0))
+    while pending:
+        yield commit(pending.pop(0))

@@ -1,0 +1,98 @@
+"""Feature-hashing tests: C++ murmur3 vs Python oracle vs sklearn parity."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from randomprojection_tpu.native.build import load_murmur3
+from randomprojection_tpu.ops.hashing import (
+    FeatureHasher,
+    _murmur3_32_py,
+    hash_tokens,
+    murmur3_32,
+)
+
+
+def test_murmur3_known_vectors():
+    # Public MurmurHash3 x86_32 test vectors (unsigned)
+    assert _murmur3_32_py(b"", 0) == 0
+    assert _murmur3_32_py(b"", 1) == 0x514E28B7
+    assert _murmur3_32_py(b"abc", 0) == 0xB3DD93FA
+    assert _murmur3_32_py(b"Hello, world!", 0x9747B28C) == 0x24884CBA
+
+
+def test_native_matches_python_oracle():
+    lib = load_murmur3()
+    assert lib is not None, "g++ is in this image; native build must succeed"
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        n = int(rng.integers(0, 40))
+        data = bytes(rng.integers(0, 256, size=n, dtype=np.uint8))
+        seed = int(rng.integers(0, 2**32))
+        assert lib.murmur3_32(data, len(data), seed) == _murmur3_32_py(data, seed)
+
+
+def test_hash_tokens_native_vs_fallback(monkeypatch):
+    tokens = ["foo", "bar", "baz qux", "", "日本語", "x" * 100]
+    idx_n, sign_n = hash_tokens(tokens, 1024)
+    # force the pure-Python path
+    monkeypatch.setattr(
+        "randomprojection_tpu.ops.hashing.load_murmur3", lambda: None
+    )
+    idx_p, sign_p = hash_tokens(tokens, 1024)
+    np.testing.assert_array_equal(idx_n, idx_p)
+    np.testing.assert_array_equal(sign_n, sign_p)
+
+
+def test_feature_hasher_sklearn_parity():
+    """Same tokens → same CSR as sklearn's Cython FeatureHasher."""
+    sk = pytest.importorskip("sklearn.feature_extraction")
+    docs = [
+        {"dog": 1.0, "cat": 2.0, "elephant": 4.0},
+        {"dog": 2.0, "run": 5.0, "": 1.0},
+        {},
+    ]
+    for alternate_sign in (True, False):
+        ours = FeatureHasher(
+            n_features=256, input_type="dict", alternate_sign=alternate_sign
+        ).transform(docs)
+        theirs = sk.FeatureHasher(
+            n_features=256, input_type="dict", alternate_sign=alternate_sign
+        ).transform(docs)
+        assert (sp.csr_matrix(ours) != sp.csr_matrix(theirs)).nnz == 0
+
+
+def test_feature_hasher_input_types():
+    s = FeatureHasher(n_features=64, input_type="string").transform(
+        [["a", "b", "a"], ["c"]]
+    )
+    p = FeatureHasher(n_features=64, input_type="pair").transform(
+        [[("a", 2.0), ("b", 1.0)], [("c", 1.0)]]
+    )
+    assert s.shape == (2, 64) and p.shape == (2, 64)
+    # "a" twice as strings == ("a", 2.0) as pair
+    np.testing.assert_allclose(s.toarray(), p.toarray())
+
+
+def test_feature_hasher_validation():
+    with pytest.raises(ValueError):
+        FeatureHasher(n_features=0)
+    with pytest.raises(ValueError):
+        FeatureHasher(input_type="nope")
+
+
+def test_feature_hasher_feeds_countsketch():
+    """Config 5 end-to-end: raw docs → hashed CSR → CountSketch → dense."""
+    from randomprojection_tpu import CountSketch
+
+    docs = [{"w%d" % (i % 50): float(i % 7 + 1) for i in range(j * 3, j * 3 + 30)}
+            for j in range(20)]
+    Xh = FeatureHasher(n_features=4096, input_type="dict").transform(docs)
+    cs = CountSketch(128, random_state=0).fit(Xh)
+    Y = cs.transform(Xh)
+    assert Y.shape == (20, 128)
+    # sketch of hashed space still approximates inner products of the CSR
+    G_true = (Xh @ Xh.T).toarray()
+    G_est = Y @ Y.T
+    scale = np.abs(G_true).max()
+    assert np.abs(G_est - G_true).max() / scale < 0.5

@@ -1,0 +1,165 @@
+"""Sketch-family tests: SimHash sign-RP and Count-Sketch (configs 4–5)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from randomprojection_tpu import (
+    CountSketch,
+    NotFittedError,
+    SignRandomProjection,
+    cosine_from_hamming,
+    pairwise_hamming,
+)
+
+
+# ---------------------------------------------------------------------------
+# SignRandomProjection / SimHash
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_sign_rp_shapes_and_determinism(backend):
+    X = np.random.default_rng(0).normal(size=(50, 128)).astype(np.float32)
+    est = SignRandomProjection(n_components=64, random_state=0, backend=backend)
+    C = est.fit(X).transform(X)
+    assert C.shape == (50, 8) and C.dtype == np.uint8
+    C2 = SignRandomProjection(
+        n_components=64, random_state=0, backend=backend
+    ).fit(X).transform(X)
+    np.testing.assert_array_equal(np.asarray(C), np.asarray(C2))
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_sign_rp_ragged_bit_width(backend):
+    X = np.random.default_rng(0).normal(size=(10, 64)).astype(np.float32)
+    C = SignRandomProjection(
+        n_components=20, random_state=0, backend=backend
+    ).fit(X).transform(X)
+    assert C.shape == (10, 3)  # ceil(20/8)
+    # pad bits beyond k are zero in every row → byte values < 2^4 in last byte
+    assert np.all(np.asarray(C)[:, -1] < 16)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_simhash_estimates_cosine(backend):
+    """Hamming/k must estimate angle: cos(π·h/k) ≈ true cosine (Charikar)."""
+    rng = np.random.default_rng(1)
+    base = rng.normal(size=(1, 256))
+    # construct vectors at controlled angles to base
+    perp = rng.normal(size=(1, 256))
+    perp -= perp @ base.T / (base @ base.T) * base
+    X = [base[0]]
+    true_cos = [1.0]
+    for theta in (np.pi / 6, np.pi / 3, np.pi / 2):
+        v = np.cos(theta) * base / np.linalg.norm(base) + np.sin(theta) * (
+            perp / np.linalg.norm(perp)
+        )
+        X.append(v[0])
+        true_cos.append(np.cos(theta))
+    X = np.asarray(X, dtype=np.float32)
+
+    k = 4096  # many bits → tight estimate
+    est = SignRandomProjection(n_components=k, random_state=2, backend=backend)
+    C = np.asarray(est.fit(X).transform(X))
+    H = pairwise_hamming(C)
+    est_cos = cosine_from_hamming(H[0], k)
+    np.testing.assert_allclose(est_cos, true_cos, atol=0.06)
+
+
+def test_sign_rp_jax_numpy_hamming_consistency():
+    """Backends use different PRNGs, but both must satisfy the SimHash
+    collision bound: hamming/k ≈ θ/π for the same data."""
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=256)
+    b = a + 0.5 * rng.normal(size=256)
+    X = np.stack([a, b]).astype(np.float32)
+    theta = np.arccos(a @ b / np.linalg.norm(a) / np.linalg.norm(b))
+    k = 4096
+    for backend in ("numpy", "jax"):
+        C = np.asarray(
+            SignRandomProjection(n_components=k, random_state=4, backend=backend)
+            .fit(X).transform(X)
+        )
+        h = pairwise_hamming(C)[0, 1]
+        np.testing.assert_allclose(h / k, theta / np.pi, atol=0.03)
+
+
+def test_sign_rp_has_no_inverse():
+    X = np.random.default_rng(0).normal(size=(10, 32)).astype(np.float32)
+    est = SignRandomProjection(n_components=16, random_state=0,
+                               backend="numpy").fit(X)
+    with pytest.raises(NotImplementedError):
+        est.inverse_transform(est.transform(X))
+
+
+def test_pairwise_hamming_matches_bruteforce():
+    rng = np.random.default_rng(0)
+    A = rng.integers(0, 256, size=(5, 4), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(3, 4), dtype=np.uint8)
+    H = pairwise_hamming(A, B)
+    for i in range(5):
+        for j in range(3):
+            expect = sum(bin(a ^ b).count("1") for a, b in zip(A[i], B[j]))
+            assert H[i, j] == expect
+
+
+# ---------------------------------------------------------------------------
+# CountSketch
+# ---------------------------------------------------------------------------
+
+
+def test_countsketch_dense_backends_identical():
+    X = np.random.default_rng(0).normal(size=(40, 300)).astype(np.float32)
+    Yj = CountSketch(64, random_state=0, backend="jax").fit(X).transform(X)
+    Yn = CountSketch(64, random_state=0, backend="numpy").fit(X).transform(X)
+    np.testing.assert_allclose(Yj, Yn, rtol=1e-6, atol=1e-6)
+
+
+def test_countsketch_csr_matches_dense():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(30, 500))
+    X[np.abs(X) < 1.0] = 0.0
+    cs = CountSketch(32, random_state=0, backend="numpy").fit(X)
+    np.testing.assert_allclose(
+        cs.transform(sp.csr_array(X)), cs.transform(X), rtol=1e-12
+    )
+
+
+def test_countsketch_decode_unbiased():
+    """E[s(j)·Y[h(j)]] = x[j]: average decode over independent sketches."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(1, 64))
+    decodes = []
+    for seed in range(400):
+        cs = CountSketch(32, random_state=seed, backend="numpy").fit_schema(1, 64)
+        decodes.append(cs.inverse_transform(cs.transform(x)))
+    # per-coordinate std of one decode ≈ sqrt(63/32) ≈ 1.4; averaging 400
+    # sketches → ≈0.07, so a 0.35 cap is ≈5σ even for the max over 64 coords
+    err = np.abs(np.mean(decodes, axis=0) - x).max()
+    assert err < 0.35, err
+
+
+def test_countsketch_preserves_inner_products():
+    """⟨sketch(x), sketch(y)⟩ ≈ ⟨x, y⟩ in expectation (AMS)."""
+    rng = np.random.default_rng(3)
+    x, y = rng.normal(size=(2, 2000))
+    dots = []
+    for seed in range(100):
+        cs = CountSketch(256, random_state=seed).fit_schema(2, 2000)
+        S = cs.transform(np.stack([x, y]))
+        dots.append(S[0] @ S[1])
+    rel_err = abs(np.mean(dots) - x @ y) / (np.linalg.norm(x) * np.linalg.norm(y))
+    assert rel_err < 0.05, rel_err
+
+
+def test_countsketch_validation():
+    with pytest.raises(ValueError):
+        CountSketch(0)
+    with pytest.raises(NotFittedError):
+        CountSketch(8).transform(np.ones((2, 4)))
+    cs = CountSketch(8, random_state=0).fit_schema(10, 16)
+    with pytest.raises(ValueError, match="features"):
+        cs.transform(np.ones((2, 5)))
+    with pytest.raises(ValueError, match="components"):
+        cs.inverse_transform(np.ones((2, 5)))

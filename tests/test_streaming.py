@@ -1,0 +1,174 @@
+"""Streaming-layer tests: batching, checkpoint/resume, fault injection
+(SURVEY.md §6 failure detection / §8 step 5)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from randomprojection_tpu import GaussianRandomProjection, SparseRandomProjection
+from randomprojection_tpu.streaming import (
+    ArraySource,
+    CallableSource,
+    FaultInjectionSource,
+    StreamCursor,
+    stream_transform,
+)
+
+
+def make_est(backend="numpy", k=16, **kw):
+    return GaussianRandomProjection(
+        n_components=k, random_state=0, backend=backend, **kw
+    )
+
+
+@pytest.fixture
+def X():
+    return np.random.default_rng(0).normal(size=(1000, 128)).astype(np.float32)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+@pytest.mark.parametrize("batch_rows", [128, 256, 1000])
+def test_stream_matches_oneshot(X, backend, batch_rows):
+    est = make_est(backend).fit_source(ArraySource(X, batch_rows))
+    Y_full = np.asarray(est.transform(X))
+    chunks = list(est.transform_stream(ArraySource(X, batch_rows)))
+    assert [lo for lo, _ in chunks] == list(range(0, 1000, batch_rows))
+    Y_stream = np.concatenate([y for _, y in chunks])
+    np.testing.assert_array_equal(Y_stream, Y_full)
+
+
+def test_stream_batch_size_invariance(X):
+    """The projection must not depend on how the stream is chopped."""
+    est = make_est("jax").fit(X)
+    ys = {
+        b: np.concatenate([y for _, y in est.transform_stream(ArraySource(X, b))])
+        for b in (100, 250, 1000)
+    }
+    np.testing.assert_array_equal(ys[100], ys[250])
+    np.testing.assert_array_equal(ys[100], ys[1000])
+
+
+def test_callable_source_out_of_core(X):
+    reads = []
+
+    def read(lo, hi):
+        reads.append((lo, hi))
+        return X[lo:hi]
+
+    src = CallableSource(read, n_rows=1000, n_features=128, dtype=X.dtype,
+                         batch_rows=300)
+    est = make_est().fit_source(src)
+    Y = np.concatenate([y for _, y in est.transform_stream(src)])
+    np.testing.assert_array_equal(Y, np.asarray(est.transform(X)))
+    assert reads == [(0, 300), (300, 600), (600, 900), (900, 1000)]
+
+
+def test_fit_source_touches_no_rows():
+    def read(lo, hi):
+        raise AssertionError("fit must not read rows")
+
+    src = CallableSource(read, n_rows=500, n_features=64, batch_rows=100)
+    est = make_est().fit_source(src)
+    assert est.n_components_ == 16 and est.n_features_in_ == 64
+
+
+def test_cursor_roundtrip(tmp_path):
+    p = str(tmp_path / "cursor.json")
+    StreamCursor(rows_done=768).save(p)
+    assert StreamCursor.load(p).rows_done == 768
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_fault_injection_resume_bit_identical(X, backend, tmp_path):
+    """Crash mid-stream, resume from the checkpoint → bit-identical output."""
+    ckpt = str(tmp_path / "cursor.json")
+    est = make_est(backend).fit(X)
+    Y_ref = np.concatenate(
+        [y for _, y in est.transform_stream(ArraySource(X, 128))]
+    )
+
+    src = FaultInjectionSource(ArraySource(X, 128), fail_after_batches=3)
+    got = []
+    with pytest.raises(FaultInjectionSource.InjectedFault):
+        for lo, y in est.transform_stream(src, checkpoint_path=ckpt):
+            got.append((lo, y))
+    committed_rows = StreamCursor.load(ckpt).rows_done
+    assert committed_rows == sum(y.shape[0] for _, y in got)
+    assert 0 < committed_rows < 1000
+
+    src.disarm()
+    for lo, y in est.transform_stream(src, checkpoint_path=ckpt):
+        assert lo == committed_rows, "resume must continue at the cursor"
+        committed_rows += y.shape[0]
+        got.append((lo, y))
+
+    Y_resumed = np.concatenate([y for _, y in got])
+    np.testing.assert_array_equal(Y_resumed, Y_ref)
+
+
+def test_stream_sparse_input_sparse_output():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 96))
+    X[X < 1.0] = 0
+    Xs = sp.csr_array(X)
+    est = SparseRandomProjection(
+        n_components=8, random_state=0, backend="numpy", dense_output=False
+    ).fit(Xs)
+    chunks = [y for _, y in est.transform_stream(ArraySource(Xs, 150))]
+    assert all(sp.issparse(y) for y in chunks)
+    ref = est.transform(Xs)
+    np.testing.assert_allclose(
+        sp.vstack(chunks).toarray(), ref.toarray(), rtol=1e-12
+    )
+
+
+def test_transform_stream_requires_fit(X):
+    from randomprojection_tpu import NotFittedError
+
+    with pytest.raises(NotFittedError):
+        list(make_est().transform_stream(ArraySource(X, 100)))
+
+
+def test_misaligned_resume_rejected(X):
+    est = make_est().fit(X)
+    src = ArraySource(X, 128)
+    with pytest.raises(ValueError, match="multiple of batch_rows"):
+        list(stream_transform(est, src, cursor=StreamCursor(rows_done=100)))
+
+
+def test_rerun_of_completed_stream_yields_nothing(X, tmp_path):
+    """A finished stream's cursor is n_rows (not a batch multiple when the
+    tail is ragged); re-running with it must be a clean no-op."""
+    ckpt = str(tmp_path / "cur.json")
+    est = make_est().fit(X)
+    src = ArraySource(X, 128)  # 1000 % 128 != 0 → ragged tail
+    n = sum(y.shape[0] for _, y in est.transform_stream(src, checkpoint_path=ckpt))
+    assert n == 1000
+    assert StreamCursor.load(ckpt).rows_done == 1000
+    assert list(est.transform_stream(src, checkpoint_path=ckpt)) == []
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_stream_sign_rp_yields_packed_codes(X, backend):
+    """Streaming must route through SignRandomProjection's override: packed
+    uint8 codes, identical to the one-shot transform."""
+    from randomprojection_tpu import SignRandomProjection
+
+    est = SignRandomProjection(
+        n_components=64, random_state=0, backend=backend
+    ).fit(X)
+    C_ref = np.asarray(est.transform(X))
+    chunks = [y for _, y in est.transform_stream(ArraySource(X, 256))]
+    assert all(y.dtype == np.uint8 for y in chunks)
+    np.testing.assert_array_equal(np.concatenate(chunks), C_ref)
+
+
+def test_stream_countsketch(X):
+    from randomprojection_tpu import CountSketch
+
+    cs = CountSketch(32, random_state=0, backend="numpy").fit_source(
+        ArraySource(X, 256)
+    )
+    Y_ref = cs.transform(X)
+    chunks = [y for _, y in cs.transform_stream(ArraySource(X, 256))]
+    np.testing.assert_allclose(np.concatenate(chunks), Y_ref, rtol=1e-6)
