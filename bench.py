@@ -4,7 +4,8 @@ Metric (BASELINE.json:2): rows/sec/chip projecting 4096→256 over 1M rows,
 plus pairwise-distance distortion vs the CPU reference.  Reported number is
 the **data-resident** throughput (SURVEY.md §7: a single host PCIe link caps
 streamed feeding at ~1M rows/s, so the chip metric must be measured with
-data on device; the streaming path is exercised separately in tests).
+data on device; the streaming path is exercised separately in tests and
+``cli stream-bench``).
 
 Method
 ------
@@ -24,147 +25,18 @@ Method
   reference is dense f32 BLAS on this host measured in the same run (the
   honest CPU number per SURVEY.md §7 — the reference's own sparse CSR path
   is orders slower).
+
+Implementation lives in ``randomprojection_tpu/benchmark.py`` (presets,
+reusable from the CLI); this wrapper keeps the driver's entry point stable.
 """
 
-import json
+import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-K, D = 256, 4096
-BATCH = 131072  # 2^17 rows per scan step; 8 steps = 1,048,576 rows per call
-STEPS_PER_CALL = 8
-TIMED_CALLS = 3
-DENSITY = 1.0 / 3.0  # Achlioptas s=3
-DISTORTION_BUDGET = 1e-3
-V5E_PEAK_TFLOPS = 197.0
-
-
-def pdist2(a):
-    sq = (a * a).sum(1)
-    d2 = sq[:, None] + sq[None, :] - 2.0 * (a @ a.T)
-    iu = np.triu_indices(a.shape[0], k=1)
-    return np.maximum(d2[iu], 1e-30)
-
-
-def measure_mode(jax, jnp, R_f32, dtype, precision):
-    """Time the chained-scan projection loop in one MXU mode."""
-    r = R_f32.astype(dtype)
-    x0 = jax.random.normal(jax.random.key(1), (BATCH, D), dtype=dtype)
-
-    @jax.jit
-    def run_steps(x, r):
-        def step(x, _):
-            y = jnp.einsum(
-                "nd,kd->nk",
-                x,
-                r,
-                preferred_element_type=jnp.float32,
-                precision=precision,
-            )
-            # chain the next input on this output: defeats DCE and
-            # identical-argument call caching; numerically negligible
-            x = x + (y[:, :1] * 1e-24).astype(x.dtype)
-            return x, y[0, 0]
-
-        return jax.lax.scan(step, x, None, length=STEPS_PER_CALL)
-
-    x, checks = run_steps(x0, r)  # warmup / compile
-    x.block_until_ready()
-
-    t0 = time.perf_counter()
-    for _ in range(TIMED_CALLS):
-        x, checks = run_steps(x, r)
-    x.block_until_ready()
-    elapsed = time.perf_counter() - t0
-
-    rows = TIMED_CALLS * STEPS_PER_CALL * BATCH
-    return {
-        "rows_per_s": rows / elapsed,
-        "elapsed_s": elapsed,
-        "rows_timed": rows,
-        "checksum": float(checks.sum()),
-    }
-
-
-def measure_distortion(jax, jnp, R_f32, x_cpu, dtype, precision):
-    """Max relative pairwise-distance error vs CPU f64, same R."""
-    xs = x_cpu[:1024]
-    y_dev = np.asarray(
-        jax.jit(
-            lambda a, b: jnp.einsum(
-                "nd,kd->nk", a, b, preferred_element_type=jnp.float32,
-                precision=precision,
-            )
-        )(jnp.asarray(xs, dtype=dtype), R_f32.astype(dtype))
-    ).astype(np.float64)
-    y_ref = xs.astype(np.float64) @ np.asarray(R_f32, dtype=np.float64).T
-    return float(np.max(np.abs(pdist2(y_dev) / pdist2(y_ref) - 1.0)))
-
-
-def main():
-    import jax
-    import jax.numpy as jnp
-
-    from randomprojection_tpu.ops import kernels
-
-    R = kernels.sparse_matrix(jax.random.key(0), K, D, DENSITY, jnp.float32)
-
-    rng = np.random.default_rng(0)
-    x_cpu = rng.normal(size=(16384, D)).astype(np.float32)
-
-    modes = {
-        "bf16": (jnp.bfloat16, "default"),
-        "f32_high": (jnp.float32, "high"),
-    }
-    results = {}
-    for name, (dtype, precision) in modes.items():
-        perf = measure_mode(jax, jnp, R, dtype, precision)
-        perf["distortion"] = measure_distortion(jax, jnp, R, x_cpu, dtype, precision)
-        results[name] = perf
-
-    eligible = [n for n, r in results.items() if r["distortion"] <= DISTORTION_BUDGET]
-    if not eligible:  # nothing meets budget: report the most accurate mode
-        eligible = [min(results, key=lambda n: results[n]["distortion"])]
-    headline = max(eligible, key=lambda n: results[n]["rows_per_s"])
-    head = results[headline]
-
-    # CPU reference: dense f32 BLAS on this host, same shapes
-    r_cpu = np.asarray(R, dtype=np.float32)
-    x_cpu @ r_cpu.T  # warm BLAS
-    t0 = time.perf_counter()
-    x_cpu @ r_cpu.T
-    cpu_rows_per_s = x_cpu.shape[0] / (time.perf_counter() - t0)
-
-    implied_tflops = head["rows_per_s"] * 2 * D * K / 1e12
-
-    print(
-        json.dumps(
-            {
-                "metric": f"rows/sec/chip 4096->256 (Achlioptas s=3, data-resident, {headline})",
-                "value": round(head["rows_per_s"], 1),
-                "unit": "rows/s",
-                "vs_baseline": round(head["rows_per_s"] / cpu_rows_per_s, 2),
-                "cpu_baseline_rows_per_s": round(cpu_rows_per_s, 1),
-                "distortion_eps_vs_cpu": head["distortion"],
-                "mode": headline,
-                "all_modes": {
-                    n: {
-                        "rows_per_s": round(r["rows_per_s"], 1),
-                        "distortion": r["distortion"],
-                        "elapsed_s": round(r["elapsed_s"], 4),
-                    }
-                    for n, r in results.items()
-                },
-                "rows_timed": head["rows_timed"],
-                "implied_tflops": round(implied_tflops, 1),
-                "timing_suspect": bool(implied_tflops > 2 * V5E_PEAK_TFLOPS),
-                "checksum": head["checksum"],
-            }
-        )
-    )
-
+from randomprojection_tpu.benchmark import main
 
 if __name__ == "__main__":
-    sys.exit(main())
+    preset = "smoke" if "--smoke" in sys.argv else "full"
+    sys.exit(main(preset))

@@ -1,0 +1,195 @@
+"""Command-line interface: ``python -m randomprojection_tpu <cmd>``.
+
+Subcommands (the reference's constructor-kwargs surface, exposed as flags —
+SURVEY.md §6 config/flag system):
+
+- ``jl-dim``        JL minimum dimension for (n, eps)
+- ``info``          devices / backends / native-component status
+- ``project``       project a .npy/.npz matrix, streamed, with checkpoint
+- ``bench``         the north-star data-resident metric (JSON line)
+- ``stream-bench``  host-streamed throughput (the PCIe-bound number;
+                    kept separate per SURVEY.md §7)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+import numpy as np
+
+
+def _add_common(p):
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "numpy", "jax"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-level", default="warning",
+                   choices=["debug", "info", "warning", "error"])
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace here")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="randomprojection_tpu",
+        description="TPU-native random projection framework",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    q = sub.add_parser("jl-dim", help="JL minimum dimension")
+    q.add_argument("--n-samples", type=int, required=True)
+    q.add_argument("--eps", type=float, default=0.1)
+
+    q = sub.add_parser("info", help="environment / backend status")
+
+    q = sub.add_parser("project", help="project a matrix from disk")
+    q.add_argument("--input", required=True, help=".npy (dense) or .npz CSR")
+    q.add_argument("--output", required=True, help="output .npy path")
+    q.add_argument("--kind", default="gaussian",
+                   choices=["gaussian", "sparse", "sign", "countsketch"])
+    q.add_argument("--n-components", default="auto",
+                   help="int or 'auto' (JL bound)")
+    q.add_argument("--eps", type=float, default=0.1)
+    q.add_argument("--density", default="auto")
+    q.add_argument("--batch-rows", type=int, default=65536)
+    q.add_argument("--checkpoint", default=None,
+                   help="cursor path for resume")
+    _add_common(q)
+
+    q = sub.add_parser("bench", help="data-resident north-star metric")
+    q.add_argument("--preset", default="smoke", choices=["smoke", "full"])
+
+    q = sub.add_parser("stream-bench", help="host-streamed throughput")
+    q.add_argument("--rows", type=int, default=262144)
+    q.add_argument("--d", type=int, default=4096)
+    q.add_argument("--k", type=int, default=256)
+    q.add_argument("--batch-rows", type=int, default=65536)
+    _add_common(q)
+
+    return p
+
+
+def cmd_jl_dim(args):
+    from randomprojection_tpu import johnson_lindenstrauss_min_dim
+
+    print(johnson_lindenstrauss_min_dim(args.n_samples, eps=args.eps))
+
+
+def cmd_info(args):
+    from randomprojection_tpu.backends import available_backends
+    from randomprojection_tpu.native.build import load_murmur3
+
+    info = {"backends": list(available_backends()),
+            "native_murmur3": load_murmur3() is not None}
+    try:
+        import jax
+
+        info["jax_devices"] = [str(d) for d in jax.devices()]
+        info["default_backend"] = jax.default_backend()
+    except Exception as e:  # pragma: no cover - degraded envs
+        info["jax_error"] = str(e)
+    print(json.dumps(info, indent=1))
+
+
+def _make_estimator(args):
+    import randomprojection_tpu as rp
+
+    k = args.n_components
+    if k != "auto":
+        k = int(k)
+    common = dict(random_state=args.seed, backend=args.backend)
+    if args.kind == "gaussian":
+        return rp.GaussianRandomProjection(k, eps=args.eps, **common)
+    if args.kind == "sparse":
+        density = args.density if args.density == "auto" else float(args.density)
+        return rp.SparseRandomProjection(k, eps=args.eps, density=density, **common)
+    if args.kind == "sign":
+        if k == "auto":
+            raise SystemExit("--kind sign requires an explicit --n-components")
+        return rp.SignRandomProjection(k, **common)
+    if k == "auto":
+        raise SystemExit("--kind countsketch requires an explicit --n-components")
+    return rp.CountSketch(k, random_state=args.seed, backend=args.backend)
+
+
+def cmd_project(args):
+    import scipy.sparse as sp
+
+    from randomprojection_tpu.streaming import ArraySource, stream_to_array
+    from randomprojection_tpu.utils.observability import (
+        StreamStats,
+        profile_trace,
+    )
+
+    if args.input.endswith(".npz"):
+        X = sp.load_npz(args.input).tocsr()
+    else:
+        X = np.load(args.input, mmap_mode="r")
+    source = ArraySource(X, args.batch_rows)
+    est = _make_estimator(args).fit_source(source)
+    stats = StreamStats(log_every=10)
+    with profile_trace(args.profile_dir):
+        Y = stream_to_array(
+            est, source, checkpoint_path=args.checkpoint, stats=stats
+        )
+    if sp.issparse(Y):
+        Y = Y.toarray()
+    np.save(args.output, Y)
+    print(json.dumps({"output": args.output, "shape": list(Y.shape),
+                      "dtype": str(Y.dtype), **stats.summary()}))
+
+
+def cmd_bench(args):
+    from randomprojection_tpu.benchmark import main as bench_main
+
+    bench_main(args.preset)
+
+
+def cmd_stream_bench(args):
+    """Host-streamed rows/s: includes h2d (PCIe) — the honest streamed
+    number, which SURVEY.md §7 R3 predicts is transfer-bound."""
+    import time
+
+    import randomprojection_tpu as rp
+    from randomprojection_tpu.streaming import ArraySource
+    from randomprojection_tpu.utils.observability import StreamStats, profile_trace
+
+    X = np.random.default_rng(0).normal(size=(args.rows, args.d)).astype(np.float32)
+    est = rp.GaussianRandomProjection(
+        args.k, random_state=args.seed, backend=args.backend
+    ).fit(X)
+    # warmup compile on one batch
+    est.transform(X[: min(args.batch_rows, args.rows)])
+    stats = StreamStats()
+    t0 = time.perf_counter()
+    with profile_trace(args.profile_dir):
+        for _ in est.transform_stream(ArraySource(X, args.batch_rows), stats=stats):
+            pass
+    elapsed = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": f"host-streamed rows/s {args.d}->{args.k}",
+        "value": round(args.rows / elapsed, 1),
+        "unit": "rows/s",
+        "bytes_in": stats.bytes_in,
+        "elapsed_s": round(elapsed, 4),
+        "backend": args.backend,
+    }))
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if hasattr(args, "log_level"):
+        logging.basicConfig(level=getattr(logging, args.log_level.upper()))
+    return {
+        "jl-dim": cmd_jl_dim,
+        "info": cmd_info,
+        "project": cmd_project,
+        "bench": cmd_bench,
+        "stream-bench": cmd_stream_bench,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -242,6 +242,10 @@ class BaseRandomProjection:
         """Dtype committed stream batches are cast to (None = leave as-is)."""
         return self.spec_.np_dtype
 
+    def _stream_out_width(self) -> int:
+        """Column count of streamed output batches."""
+        return self.n_components_
+
     def fit_source(self, source):
         """Fit from a ``RowBatchSource`` schema — zero rows materialized."""
         n_rows, n_features, dtype = source.schema()

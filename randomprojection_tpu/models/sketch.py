@@ -71,6 +71,9 @@ class SignRandomProjection(BaseRandomProjection):
     def _stream_out_dtype(self):
         return np.uint8
 
+    def _stream_out_width(self) -> int:
+        return -(-self.n_components_ // 8)  # packed bytes per row
+
     def inverse_transform(self, Y):
         raise NotImplementedError(
             "Sign codes discard magnitudes; SimHash has no inverse. "
@@ -163,6 +166,10 @@ class CountSketch:
         return Y
 
     def _transform_dense_jax(self, X):
+        if X.dtype == np.float64:
+            # jax (x64 disabled) would silently truncate to f32, breaking
+            # the documented numpy/jax identity; f64 stays on host
+            return self._transform_dense_np(X)
         import jax
         import jax.numpy as jnp
 
@@ -215,6 +222,9 @@ class CountSketch:
 
     def _stream_out_dtype(self):
         return None  # keep whatever dtype transform produced
+
+    def _stream_out_width(self) -> int:
+        return self.n_components_
 
     def inverse_transform(self, Y):
         """Unbiased decode: ``x̂[j] = s(j) · Y[:, h(j)]``."""

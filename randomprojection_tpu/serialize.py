@@ -1,0 +1,132 @@
+"""Fitted-model persistence (SURVEY.md §6 checkpoint/resume).
+
+A fitted projection is fully determined by its ``ProjectionSpec`` (seed +
+shape + kind + density + dtype) — a few hundred bytes of JSON.  Loading
+re-materializes the matrix with any backend, bit-identical within the
+backend family that saved it.  Optionally the materialized matrix (and
+pinv) are bundled as ``.npz`` for backend-independent exact restore.
+
+Format is versioned; readers reject unknown versions loudly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from randomprojection_tpu.backends.base import ProjectionSpec
+
+__all__ = ["save_model", "load_model"]
+
+FORMAT_VERSION = 1
+
+_CLASSES = {}
+
+
+def _registry():
+    # deferred import to avoid cycles
+    from randomprojection_tpu.models.projections import (
+        GaussianRandomProjection,
+        SparseRandomProjection,
+    )
+    from randomprojection_tpu.models.sketch import CountSketch, SignRandomProjection
+
+    if not _CLASSES:
+        for cls in (
+            GaussianRandomProjection,
+            SparseRandomProjection,
+            SignRandomProjection,
+            CountSketch,
+        ):
+            _CLASSES[cls.__name__] = cls
+    return _CLASSES
+
+
+def save_model(est, path: str, *, include_matrix: bool = False) -> None:
+    """Save a fitted estimator to ``path`` (JSON; ``path + '.npz'`` if
+    ``include_matrix``)."""
+    est._check_is_fitted()
+    payload = {
+        "format_version": FORMAT_VERSION,
+        "class": type(est).__name__,
+    }
+    if hasattr(est, "spec_"):
+        payload["spec"] = est.spec_.to_dict()
+        payload["params"] = {
+            "dense_output": getattr(est, "dense_output", None),
+            "compute_inverse_components": est.compute_inverse_components,
+        }
+    else:  # CountSketch: seed-defined, no dense spec
+        payload["countsketch"] = {
+            "n_components": est.n_components_,
+            "n_features": est.n_features_in_,
+            "seed": est.seed_,
+        }
+    if include_matrix and hasattr(est, "spec_"):
+        import scipy.sparse as sp
+
+        arrays = {}
+        R = est.components_as_numpy()
+        if sp.issparse(R):
+            R = R.toarray()
+        arrays["components"] = np.asarray(R)
+        inv = getattr(est, "inverse_components_", None)
+        if inv is not None:
+            arrays["inverse_components"] = np.asarray(inv)
+        np.savez(path + ".npz", **arrays)
+        payload["matrix_file"] = os.path.basename(path) + ".npz"
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+
+
+def load_model(path: str, *, backend: Optional[str] = None):
+    """Load a fitted estimator saved by ``save_model``.
+
+    ``backend`` overrides the execution backend ('numpy'/'jax'); the
+    projection re-materializes from the stored seed.  If a matrix bundle
+    exists it is NOT loaded implicitly — the seed is the source of truth
+    (pass the bundle to analyses that need the exact f64 matrix).
+    """
+    with open(path) as f:
+        payload = json.load(f)
+    version = payload.get("format_version")
+    if version != FORMAT_VERSION:
+        raise ValueError(
+            f"Unsupported model format version {version!r} in {path} "
+            f"(expected {FORMAT_VERSION})"
+        )
+    cls = _registry().get(payload.get("class"))
+    if cls is None:
+        raise ValueError(f"Unknown model class {payload.get('class')!r} in {path}")
+
+    if "countsketch" in payload:
+        d = payload["countsketch"]
+        est = cls(d["n_components"], random_state=d["seed"],
+                  backend=backend or "auto")
+        est.fit_schema(1, d["n_features"])
+        return est
+
+    spec = ProjectionSpec.from_dict(payload["spec"])
+    kwargs = {}
+    params = payload.get("params", {})
+    if params.get("dense_output") is not None:
+        kwargs["dense_output"] = params["dense_output"]
+    if spec.kind == "sparse":
+        kwargs["density"] = spec.density
+    est = cls(
+        spec.n_components,
+        random_state=spec.seed,
+        backend=backend or "auto",
+        compute_inverse_components=bool(params.get("compute_inverse_components")),
+        **kwargs,
+    )
+    # n_samples only gates auto-dim, which a fixed-k respec never triggers
+    est.fit_schema(1, spec.n_features, dtype=spec.np_dtype)
+    assert est.spec_ == spec, "re-materialized spec must round-trip exactly"
+    return est

@@ -36,6 +36,7 @@ __all__ = [
     "FaultInjectionSource",
     "StreamCursor",
     "stream_transform",
+    "stream_to_array",
 ]
 
 
@@ -193,6 +194,7 @@ def stream_transform(
     cursor: Optional[StreamCursor] = None,
     checkpoint_path: Optional[str] = None,
     pipeline_depth: int = 2,
+    stats=None,
 ) -> Iterator[Tuple[int, np.ndarray]]:
     """Project a stream, yielding ``(start_row, Y_batch)`` in row order.
 
@@ -216,10 +218,13 @@ def stream_transform(
     estimator._check_is_fitted()
     out_dtype = estimator._stream_out_dtype()
 
-    pending: list = []  # [(start_row, n_rows, Y_lazy)]
+    if stats is not None:
+        stats.start()
+
+    pending: list = []  # [(start_row, n_rows, Y_lazy, in_nbytes)]
 
     def commit(entry):
-        start_row, n_rows, y = entry
+        start_row, n_rows, y, in_nbytes = entry
         if not sp.issparse(y):  # forces device→host for lazy handles
             y = np.asarray(y)
             if out_dtype is not None:
@@ -227,14 +232,64 @@ def stream_transform(
         cursor.rows_done = start_row + n_rows
         if checkpoint_path is not None:
             cursor.save(checkpoint_path)
+        if stats is not None:
+            stats.on_commit(start_row, in_nbytes, y)
         return start_row, y
 
     for start_row, batch in source.iter_batches(cursor.rows_done):
         # _transform_async is each estimator's own (possibly overridden)
         # transform, returning a lazy device handle where supported
         y = estimator._transform_async(batch)
-        pending.append((start_row, batch.shape[0], y))
+        # keep only the byte count: retaining the batch itself would pin
+        # pipeline_depth extra input batches of host memory
+        pending.append((start_row, batch.shape[0], y, getattr(batch, "nbytes", 0)))
         if len(pending) >= pipeline_depth:
             yield commit(pending.pop(0))
     while pending:
         yield commit(pending.pop(0))
+
+
+def stream_to_array(estimator, source, out=None, **kwargs) -> np.ndarray:
+    """Convenience: run ``stream_transform`` into one preallocated array.
+
+    ``out`` defaults to a new ndarray of the stream's full output shape —
+    only sensible when that fits in host memory.  Resuming a
+    partially-complete checkpoint REQUIRES passing the ``out`` buffer from
+    the earlier run (a fresh buffer would leave the already-committed rows
+    uninitialized); a fully-complete checkpoint returns ``out`` unchanged
+    (or an empty array when no buffer is given).
+    """
+    cursor = kwargs.get("cursor")
+    checkpoint_path = kwargs.get("checkpoint_path")
+    if cursor is None and checkpoint_path is not None and os.path.exists(
+        checkpoint_path
+    ):
+        cursor = StreamCursor.load(checkpoint_path)
+    resume_start = cursor.rows_done if cursor is not None else 0
+    if out is None and 0 < resume_start < source.n_rows:
+        raise ValueError(
+            f"Resuming from rows_done={resume_start} without the output "
+            "buffer of the interrupted run would leave earlier rows "
+            "uninitialized; pass out= (or clear the checkpoint to restart)"
+        )
+
+    chunks = []
+    for start_row, y in stream_transform(estimator, source, **kwargs):
+        if out is None and not chunks and not sp.issparse(y):
+            out = np.empty((source.n_rows, y.shape[1]), dtype=y.dtype)
+        if out is not None:
+            out[start_row : start_row + y.shape[0]] = (
+                y.toarray() if sp.issparse(y) else y
+            )
+        else:
+            chunks.append(y)
+    if out is not None:
+        return out
+    if chunks:
+        return (
+            sp.vstack(chunks) if sp.issparse(chunks[0]) else np.concatenate(chunks)
+        )
+    # empty stream (0-row source, or a completed checkpoint with no buffer)
+    width = estimator._stream_out_width()
+    dtype = estimator._stream_out_dtype() or np.float64
+    return np.empty((0, width), dtype=dtype)

@@ -1,0 +1,99 @@
+"""Observability: per-batch stream counters + profiler hooks (SURVEY.md §6).
+
+The reference's only instrumentation is Python warnings (and Spark's web UI
+on the spark backend); here streams carry structured counters and any
+transform region can be wrapped in a ``jax.profiler`` trace for
+TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger("randomprojection_tpu")
+
+__all__ = ["StreamStats", "profile_trace", "annotate", "logger"]
+
+
+class StreamStats:
+    """Running counters for a streamed transform.
+
+    Pass to ``stream_transform(..., stats=...)``; updated at every commit
+    (host materialization), so throughput includes the full h2d → einsum →
+    d2h pipeline, not just dispatch.
+    """
+
+    def __init__(self, log_every: int = 0):
+        self.log_every = log_every
+        self.batches = 0
+        self.rows = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self._t0: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    def start(self) -> None:
+        """Start the clock — called by ``stream_transform`` before the first
+        batch is dispatched, so throughput includes the first batch's full
+        h2d → einsum → d2h time (not just inter-commit gaps)."""
+        self._t0 = time.perf_counter()
+
+    def on_commit(self, start_row: int, bytes_in: int, batch_out) -> None:
+        now = time.perf_counter()
+        if self._t0 is None:  # standalone use without start(): degrade
+            self._t0 = now
+        self._t_last = now
+        self.batches += 1
+        n = getattr(batch_out, "shape", (0,))[0]
+        self.rows += n
+        self.bytes_in += bytes_in
+        self.bytes_out += getattr(batch_out, "nbytes", 0)
+        if self.log_every and self.batches % self.log_every == 0:
+            logger.info(
+                "stream: %d batches, %d rows, %.0f rows/s",
+                self.batches, self.rows, self.rows_per_s(),
+            )
+
+    def elapsed_s(self) -> float:
+        if self._t0 is None or self._t_last is None:
+            return 0.0
+        return max(self._t_last - self._t0, 1e-9)
+
+    def rows_per_s(self) -> float:
+        return self.rows / self.elapsed_s() if self.rows else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "batches": self.batches,
+            "rows": self.rows,
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            "elapsed_s": round(self.elapsed_s(), 4),
+            "rows_per_s": round(self.rows_per_s(), 1),
+        }
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]):
+    """Wrap a region in ``jax.profiler.trace`` (no-op when ``log_dir`` is
+    falsy, so callers can thread a ``--profile-dir`` flag unconditionally)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named region visible in profiler timelines (no-op without jax)."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except ImportError:  # pragma: no cover
+        return contextlib.nullcontext()

@@ -1,0 +1,179 @@
+"""Persistence, observability, and CLI tests (SURVEY.md §6 subsystems)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu import (
+    CountSketch,
+    GaussianRandomProjection,
+    SignRandomProjection,
+    SparseRandomProjection,
+)
+from randomprojection_tpu.serialize import load_model, save_model
+
+
+@pytest.mark.parametrize(
+    "make",
+    [
+        lambda: GaussianRandomProjection(16, random_state=7, backend="numpy"),
+        lambda: SparseRandomProjection(16, random_state=7, density=0.25,
+                                       backend="numpy"),
+        lambda: SignRandomProjection(16, random_state=7, backend="numpy"),
+        lambda: CountSketch(16, random_state=7, backend="numpy"),
+    ],
+)
+def test_save_load_roundtrip(tmp_path, make):
+    X = np.random.default_rng(0).normal(size=(50, 128)).astype(np.float32)
+    est = make().fit(X)
+    Y = np.asarray(est.transform(X))
+    p = str(tmp_path / "model.json")
+    save_model(est, p)
+    est2 = load_model(p, backend="numpy")
+    np.testing.assert_array_equal(np.asarray(est2.transform(X)), Y)
+
+
+def test_save_load_cross_backend_same_family(tmp_path):
+    """jax→jax reload reproduces exactly (counter-based PRNG from the seed)."""
+    X = np.random.default_rng(0).normal(size=(40, 96)).astype(np.float32)
+    est = GaussianRandomProjection(8, random_state=3, backend="jax").fit(X)
+    Y = np.asarray(est.transform(X))
+    p = str(tmp_path / "m.json")
+    save_model(est, p)
+    np.testing.assert_array_equal(
+        np.asarray(load_model(p, backend="jax").transform(X)), Y
+    )
+
+
+def test_save_with_matrix_bundle(tmp_path):
+    X = np.random.default_rng(0).normal(size=(30, 64))
+    est = GaussianRandomProjection(
+        8, random_state=0, backend="numpy", compute_inverse_components=True
+    ).fit(X)
+    p = str(tmp_path / "m.json")
+    save_model(est, p, include_matrix=True)
+    bundle = np.load(p + ".npz")
+    np.testing.assert_array_equal(bundle["components"], est.components_)
+    assert bundle["inverse_components"].shape == (64, 8)
+
+
+def test_load_rejects_bad_version(tmp_path):
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps({"format_version": 99, "class": "X"}))
+    with pytest.raises(ValueError, match="version"):
+        load_model(str(p))
+
+
+def test_unfitted_save_raises(tmp_path):
+    from randomprojection_tpu import NotFittedError
+
+    with pytest.raises(NotFittedError):
+        save_model(GaussianRandomProjection(4), str(tmp_path / "m.json"))
+
+
+def test_stream_stats():
+    from randomprojection_tpu.streaming import ArraySource
+    from randomprojection_tpu.utils.observability import StreamStats
+
+    X = np.random.default_rng(0).normal(size=(500, 64)).astype(np.float32)
+    est = GaussianRandomProjection(8, random_state=0, backend="numpy").fit(X)
+    stats = StreamStats()
+    for _ in est.transform_stream(ArraySource(X, 100), stats=stats):
+        pass
+    s = stats.summary()
+    assert s["rows"] == 500 and s["batches"] == 5
+    assert s["bytes_in"] == X.nbytes
+    assert s["rows_per_s"] > 0
+
+
+def test_stream_to_array_resume_and_empty(tmp_path):
+    from randomprojection_tpu.streaming import (
+        ArraySource,
+        StreamCursor,
+        stream_to_array,
+    )
+
+    X = np.random.default_rng(0).normal(size=(500, 64)).astype(np.float32)
+    est = GaussianRandomProjection(8, random_state=0, backend="numpy").fit(X)
+    src = ArraySource(X, 100)
+    ckpt = str(tmp_path / "c.json")
+    Y = stream_to_array(est, src, checkpoint_path=ckpt)
+    assert Y.shape == (500, 8)
+    # completed checkpoint, no buffer → empty result, not a crash
+    Y2 = stream_to_array(est, src, checkpoint_path=ckpt)
+    assert Y2.shape == (0, 8)
+    # partial checkpoint without the original buffer → loud error
+    StreamCursor(rows_done=200).save(ckpt)
+    with pytest.raises(ValueError, match="uninitialized"):
+        stream_to_array(est, src, checkpoint_path=ckpt)
+    # with the buffer: fills the remaining rows, result complete
+    out = np.zeros((500, 8), dtype=np.float32)
+    out[:200] = Y[:200]
+    Y3 = stream_to_array(est, src, checkpoint_path=ckpt, out=out)
+    np.testing.assert_array_equal(Y3, Y)
+
+
+def test_countsketch_f64_identical_across_backends():
+    X = np.random.default_rng(0).normal(size=(20, 100))  # float64
+    Yj = CountSketch(16, random_state=0, backend="jax").fit(X).transform(X)
+    Yn = CountSketch(16, random_state=0, backend="numpy").fit(X).transform(X)
+    assert Yj.dtype == np.float64
+    np.testing.assert_array_equal(Yj, Yn)
+
+
+def test_stream_stats_single_batch_sane():
+    from randomprojection_tpu.streaming import ArraySource
+    from randomprojection_tpu.utils.observability import StreamStats
+
+    X = np.random.default_rng(0).normal(size=(200, 64)).astype(np.float32)
+    est = GaussianRandomProjection(8, random_state=0, backend="numpy").fit(X)
+    stats = StreamStats()
+    for _ in est.transform_stream(ArraySource(X, 1000), stats=stats):
+        pass
+    # one batch: the clock must span the whole pipeline, not be ~1e-9
+    assert stats.batches == 1
+    assert stats.rows_per_s() < 1e10
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "randomprojection_tpu", *argv],
+        capture_output=True, text=True, timeout=300,
+        env={"PYTHONPATH": ".", "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "HOME": "/root"},
+    )
+
+
+def test_cli_jl_dim():
+    r = _run_cli("jl-dim", "--n-samples", "1000000", "--eps", "0.5")
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "663"
+
+
+def test_cli_info():
+    r = _run_cli("info")
+    assert r.returncode == 0, r.stderr
+    info = json.loads(r.stdout)
+    assert "numpy" in info["backends"] and "jax" in info["backends"]
+    assert info["native_murmur3"] is True
+
+
+def test_cli_project_roundtrip(tmp_path):
+    X = np.random.default_rng(0).normal(size=(300, 128)).astype(np.float32)
+    xin = str(tmp_path / "x.npy")
+    yout = str(tmp_path / "y.npy")
+    np.save(xin, X)
+    r = _run_cli(
+        "project", "--input", xin, "--output", yout,
+        "--kind", "gaussian", "--n-components", "16",
+        "--backend", "numpy", "--batch-rows", "100", "--seed", "5",
+    )
+    assert r.returncode == 0, r.stderr
+    meta = json.loads(r.stdout.splitlines()[-1])
+    assert meta["shape"] == [300, 16] and meta["rows"] == 300
+    Y = np.load(yout)
+    ref = GaussianRandomProjection(16, random_state=5, backend="numpy").fit(X)
+    np.testing.assert_allclose(Y, np.asarray(ref.transform(X)), rtol=1e-6)
