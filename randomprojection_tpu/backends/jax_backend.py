@@ -42,6 +42,20 @@ def _pad_rows(n: int) -> int:
     return max(8, 1 << (n - 1).bit_length())
 
 
+class _LazyMask:
+    """State of ``materialization='lazy'``: no array — just the PRNG seed.
+
+    The matrix is regenerated inside the fused Pallas kernel per transform
+    (``ops/pallas_kernels.py``), so it is never resident in HBM.
+    """
+
+    __slots__ = ("seed", "density")
+
+    def __init__(self, seed: int, density: float):
+        self.seed = seed
+        self.density = float(density)
+
+
 class JaxBackend(ProjectionBackend):
     """XLA executor: device-resident R, jit einsum transform."""
 
@@ -55,6 +69,7 @@ class JaxBackend(ProjectionBackend):
         mesh: Optional[object] = None,
         data_axis: str = "data",
         feature_axis: Optional[str] = None,
+        materialization: str = "dense",
     ):
         import jax  # deferred: `backend='numpy'` must never import jax
 
@@ -68,9 +83,20 @@ class JaxBackend(ProjectionBackend):
         self.mesh = mesh
         self.data_axis = data_axis
         self.feature_axis = feature_axis
+        if materialization not in ("dense", "lazy"):
+            raise ValueError(
+                f"materialization must be 'dense' or 'lazy', got {materialization!r}"
+            )
+        if materialization == "lazy" and mesh is not None:
+            raise NotImplementedError(
+                "materialization='lazy' is single-device for now; use the "
+                "dense path under a mesh"
+            )
+        self.materialization = materialization
         self._transform_fn = None
         self._inverse_fn = None
         self._sign_fn = None
+        self._pack_fn = None
 
     # -- sharding helpers ---------------------------------------------------
 
@@ -95,6 +121,29 @@ class JaxBackend(ProjectionBackend):
         import jax.numpy as jnp
 
         from randomprojection_tpu.ops import kernels
+
+        if self.materialization == "lazy":
+            if spec.kind not in ("sparse", "rademacher"):
+                raise ValueError(
+                    "materialization='lazy' regenerates the mask in-kernel and "
+                    f"supports kind='sparse'/'rademacher' only, got {spec.kind!r}"
+                )
+            if jax.default_backend() in ("cpu", "gpu", "cuda", "rocm"):
+                # the mask is defined by the TPU hardware PRNG (pltpu.prng_*):
+                # no CPU/GPU emulation — the interpreter returns zero bits,
+                # which would silently produce a zero matrix — refuse instead
+                raise RuntimeError(
+                    "materialization='lazy' requires a TPU backend (the "
+                    "in-kernel PRNG has no CPU/GPU emulation); use the default "
+                    "dense materialization"
+                )
+            if spec.n_components % 8:
+                # fail at fit, like the dense path's materialization would
+                raise ValueError(
+                    "materialization='lazy' needs n_components to be a "
+                    f"multiple of 8 (f32 sublane tiling), got {spec.n_components}"
+                )
+            return _LazyMask(spec.seed, spec.density if spec.kind == "sparse" else 1.0)
 
         key = jax.random.key(spec.seed)
         dtype = jnp.dtype(self.compute_dtype)
@@ -140,7 +189,7 @@ class JaxBackend(ProjectionBackend):
         return self._transform_fn
 
     def transform(self, X, state, spec: ProjectionSpec, *, dense_output: bool = True):
-        y, device_resident = self._transform_impl(X, state)
+        y, device_resident = self._transform_impl(X, state, spec)
         if device_resident:
             return y
         return np.asarray(y).astype(spec.np_dtype, copy=False)
@@ -150,7 +199,7 @@ class JaxBackend(ProjectionBackend):
     ):
         # device-resident handle either way; the stream pipeline fetches it
         # later, overlapping with the next batch's dispatch
-        y, _ = self._transform_impl(X, state)
+        y, _ = self._transform_impl(X, state, spec)
         return y
 
     def _prepare_rows(self, X):
@@ -182,9 +231,26 @@ class JaxBackend(ProjectionBackend):
             x = jax.device_put(x, row_sharding)
         return x, n, device_resident
 
-    def _transform_impl(self, X, state):
+    def _transform_impl(self, X, state, spec: ProjectionSpec):
         x, n, device_resident = self._prepare_rows(X)
-        y = self._get_transform_fn()(x, state)
+        if isinstance(state, _LazyMask):
+            from randomprojection_tpu.ops.pallas_kernels import (
+                fused_sparse_project,
+            )
+
+            from randomprojection_tpu.ops.pallas_kernels import BLOCK_N
+
+            y = fused_sparse_project(
+                x.astype(self._jax.numpy.float32),
+                state.seed,
+                spec.n_components,
+                state.density,
+                # x is already row-bucketed (power of two ≥ 8): matching the
+                # kernel row tile avoids re-padding small batches to BLOCK_N
+                block_n=min(BLOCK_N, x.shape[0]),
+            ).astype(x.dtype)
+        else:
+            y = self._get_transform_fn()(x, state)
         return y[:n], device_resident
 
     def transform_packed_signs(
@@ -212,15 +278,36 @@ class JaxBackend(ProjectionBackend):
 
             self._sign_fn = _sign_project
 
-        x, n, device_resident = self._prepare_rows(X)
-        y = self._sign_fn(x, state)[:n]
+        if isinstance(state, _LazyMask):
+            # lazy path: fused mask-projection, then pack on device
+            y_coords, device_resident = self._transform_impl(X, state, spec)
+            if self._pack_fn is None:
+                self._pack_fn = jax.jit(
+                    lambda a: jnp.packbits(a > 0, axis=-1, bitorder="little")
+                )
+            y = self._pack_fn(y_coords)
+        else:
+            x, n, device_resident = self._prepare_rows(X)
+            y = self._sign_fn(x, state)[:n]
         if device_resident or not materialize:
             return y
         return np.asarray(y)
 
+    def _lazy_matrix(self, state, spec: ProjectionSpec):
+        from randomprojection_tpu.ops.pallas_kernels import pallas_sparse_matrix
+
+        return pallas_sparse_matrix(
+            state.seed,
+            spec.n_components,
+            spec.n_features,
+            state.density,
+        )
+
     def inverse_components(self, state, spec: ProjectionSpec) -> np.ndarray:
         import jax.numpy as jnp
 
+        if isinstance(state, _LazyMask):
+            state = self._lazy_matrix(state, spec)
         # XLA SVD on the small (k, d) matrix; host copy for serialization
         return np.asarray(jnp.linalg.pinv(state.astype(jnp.float32)))
 
@@ -250,4 +337,6 @@ class JaxBackend(ProjectionBackend):
         return np.asarray(x).astype(spec.np_dtype, copy=False)
 
     def components_to_numpy(self, state, spec: ProjectionSpec):
+        if isinstance(state, _LazyMask):
+            state = self._lazy_matrix(state, spec)
         return np.asarray(state).astype(spec.np_dtype, copy=False)

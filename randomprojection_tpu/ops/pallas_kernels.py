@@ -1,0 +1,208 @@
+"""Pallas TPU kernel: fused projection with in-kernel mask regeneration.
+
+The memory-bound regime (SURVEY.md §8 step 4b): when ``k·d`` is large
+(config 3: 512×16384, config 4 at code length 4096+), keeping ``R``
+resident costs HBM capacity *and* bandwidth — every batch re-reads k·d
+values.  Since sparse/sign projection matrices are pure PRNG functions of
+``(seed, block)``, this kernel regenerates each ``(k, BLOCK_D)`` column
+block **inside VMEM** from the TPU's hardware PRNG while contracting, so
+``R`` never exists in HBM at all: HBM traffic drops from
+``n·d + k·d + n·k`` to ``n·d + n·k`` per batch.
+
+Matrix definition
+-----------------
+Block ``j`` of the matrix is a pure function of ``(seed, j)`` via
+``pltpu.prng_seed(seed, j)`` — deterministic, row-tile-independent, and
+reproducible across any row batching.  This is a *third* PRNG family
+(alongside the numpy backend's Generator and the jax backend's threefry):
+same distribution, different streams, as SURVEY.md §8 prescribes —
+cross-family parity holds at the distance-distortion level only.
+``BLOCK_D`` is part of the definition (like ``kernels.COLUMN_BLOCK``).
+
+The mask is generated as exact ``{+1, -1, 0}`` values and the common scale
+``v = sqrt(1/(density·k))`` is applied once to the accumulated output, so
+mask quantization contributes zero error regardless of MXU precision.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from randomprojection_tpu.utils.validation import check_density, check_input_size
+
+__all__ = ["BLOCK_D", "BLOCK_N", "fused_sparse_project", "pallas_sparse_matrix"]
+
+BLOCK_D = 512  # contraction-dim tile; part of the matrix definition
+BLOCK_N = 256  # row tile (tunable; does NOT affect the matrix)
+
+
+def _seed_to_i32(seed) -> int:
+    """Fold any Python int seed into int32 (the SMEM scalar width).
+
+    Part of the matrix definition: seeds are taken mod 2^32 and
+    reinterpreted signed, so uint32 seeds from unseeded fits work."""
+    import numpy as np
+
+    return int(np.uint32(int(seed) & 0xFFFFFFFF).astype(np.int32))
+
+
+def _uniform_from_bits(bits):
+    # top 24 bits → uniform f32 in [0, 1): exact ulp spacing, no rounding
+    # bias.  prng_random_bits yields signed int32 — bitcast to uint32 first
+    # or the arithmetic shift folds the sign in and u spans [-0.5, 0.5).
+    bits = pltpu.bitcast(bits, jnp.uint32) >> 8
+    # Mosaic lacks uint32→f32; post-shift values fit in int31, so the
+    # int32 reinterpretation is value-preserving and casts fine
+    return pltpu.bitcast(bits, jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+def _mask_block(density):
+    """{+1, -1, 0} w.p. {density/2, density/2, 1-density} from one uniform."""
+
+    def gen(shape):
+        u = _uniform_from_bits(pltpu.prng_random_bits(shape))
+        plus = u < density * 0.5
+        minus = jnp.logical_and(u < density, jnp.logical_not(plus))
+        return jnp.where(plus, 1.0, jnp.where(minus, -1.0, 0.0))
+
+    return gen
+
+
+def _project_kernel(seed_ref, x_ref, o_ref, *, k, density, scale, n_blocks_d):
+    j = pl.program_id(1)
+    pltpu.prng_seed(seed_ref[0], j)  # (seed, block) → bits: row-tile-free
+    r = _mask_block(density)((k, x_ref.shape[1]))
+
+    @pl.when(j == 0)
+    def _():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += jax.lax.dot_general(
+        x_ref[:],
+        r,
+        dimension_numbers=(((1,), (1,)), ((), ())),  # x[n,d] · r[k,d] → [n,k]
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == n_blocks_d - 1)
+    def _():
+        o_ref[:] = o_ref[:] * scale
+
+
+def _matrix_kernel(seed_ref, o_ref, *, k, density, scale):
+    j = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0], j)
+    o_ref[:] = _mask_block(density)((k, o_ref.shape[1])) * scale
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("seed", "n_components", "density", "block_n", "interpret"),
+)
+def fused_sparse_project(
+    x,
+    seed,
+    n_components: int,
+    density: float,
+    *,
+    block_n: int = BLOCK_N,
+    interpret: bool = False,
+):
+    """``Y = X @ R(seed)ᵀ`` with ``R`` regenerated in-kernel, never in HBM.
+
+    ``density=1`` degenerates to the sign/Rademacher kernel.  ``x`` is any
+    ``(n, d)`` float array; ``n_components`` must be a multiple of 8 (f32
+    sublane tiling).  Ragged ``n``/``d`` are zero-padded (zero rows/cols
+    contribute nothing; the mask block for padded ``d`` is generated but
+    multiplied by zeros).
+    """
+    density = check_density(density, x.shape[1])
+    check_input_size(n_components, x.shape[1])
+    if n_components % 8:
+        raise ValueError(
+            f"n_components must be a multiple of 8 for the fused TPU kernel, "
+            f"got {n_components}"
+        )
+    n, d = x.shape
+    k = n_components
+    scale = 1.0 / math.sqrt(density * k)
+
+    seed = _seed_to_i32(seed)
+    n_pad = -n % block_n
+    d_pad = -d % BLOCK_D
+    if n_pad or d_pad:
+        x = jnp.pad(x, ((0, n_pad), (0, d_pad)))
+    x = x.astype(jnp.float32)
+    ni = x.shape[0] // block_n
+    nj = x.shape[1] // BLOCK_D
+
+    seed_arr = jnp.asarray([seed], dtype=jnp.int32)
+    y = pl.pallas_call(
+        functools.partial(
+            _project_kernel, k=k, density=density, scale=scale, n_blocks_d=nj
+        ),
+        grid=(ni, nj),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (block_n, BLOCK_D),
+                lambda i, j: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_n, k), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], k), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * x.shape[0] * x.shape[1] * k,
+            bytes_accessed=x.shape[0] * x.shape[1] * 4 + x.shape[0] * k * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(seed_arr, x)
+    return y[:n]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("seed", "n_components", "n_features", "density", "interpret"),
+)
+def pallas_sparse_matrix(
+    seed, n_components: int, n_features: int, density: float, *,
+    interpret: bool = False
+):
+    """Materialize the exact matrix ``fused_sparse_project`` uses (tests,
+    ``components_`` introspection, pinv).  Same ``(seed, block)`` streams."""
+    density = check_density(density, n_features)
+    check_input_size(n_components, n_features)
+    if n_components % 8:
+        raise ValueError(
+            f"n_components must be a multiple of 8 for the fused TPU kernel, "
+            f"got {n_components}"
+        )
+    seed = _seed_to_i32(seed)
+    k = n_components
+    scale = 1.0 / math.sqrt(density * k)
+    d_pad = -n_features % BLOCK_D
+    d_full = n_features + d_pad
+    nj = d_full // BLOCK_D
+
+    seed_arr = jnp.asarray([seed], dtype=jnp.int32)
+    R = pl.pallas_call(
+        functools.partial(_matrix_kernel, k=k, density=density, scale=scale),
+        grid=(nj,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(
+            (k, BLOCK_D), lambda j: (0, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((k, d_full), jnp.float32),
+        interpret=interpret,
+    )(seed_arr)
+    return R[:, :n_features]
