@@ -27,6 +27,7 @@ _LAZY_ESTIMATORS = (
     "SignRandomProjection",
     "CountSketch",
     "pairwise_hamming",
+    "pairwise_hamming_device",
     "cosine_from_hamming",
 )
 

@@ -56,6 +56,22 @@ class _LazyMask:
         self.density = float(density)
 
 
+class _SplitMask:
+    """State of ``precision='split2'``: unscaled ±1/0 mask in bf16 + scale.
+
+    The mask entries are exact in bf16, so the two-pass split projection
+    (``ops/split_matmul.py``) delivers f32-grade output at ~2 bf16 MXU
+    passes — the fastest mode inside the 1e-3 distortion budget for the
+    sparse/sign kernels.
+    """
+
+    __slots__ = ("mask", "scale")
+
+    def __init__(self, mask, scale: float):
+        self.mask = mask
+        self.scale = float(scale)
+
+
 class JaxBackend(ProjectionBackend):
     """XLA executor: device-resident R, jit einsum transform."""
 
@@ -79,6 +95,11 @@ class JaxBackend(ProjectionBackend):
         self.compute_dtype = compute_dtype
         if precision is None:
             precision = default_matmul_precision(compute_dtype)
+        if precision not in ("default", "high", "highest", "split2"):
+            raise ValueError(
+                "precision must be 'default', 'high', 'highest' or 'split2', "
+                f"got {precision!r}"
+            )
         self.precision = precision
         self.mesh = mesh
         self.data_axis = data_axis
@@ -92,27 +113,48 @@ class JaxBackend(ProjectionBackend):
                 "materialization='lazy' is single-device for now; use the "
                 "dense path under a mesh"
             )
+        if precision == "split2" and feature_axis is not None:
+            raise NotImplementedError(
+                "precision='split2' does not yet compose with feature-axis "
+                "TP; use precision='high' (or DP-only split2)"
+            )
         self.materialization = materialization
         self._transform_fn = None
         self._inverse_fn = None
         self._sign_fn = None
         self._pack_fn = None
+        self._split_fn = None
+
+    def _einsum_precision(self) -> str:
+        """Precision for plain einsums ('split2' applies only to the mask
+        matmul path; other einsums — pinv reconstruct, gaussian sign — use
+        the accuracy-equivalent 'high')."""
+        return self.precision if self.precision != "split2" else "high"
 
     # -- sharding helpers ---------------------------------------------------
 
     def _replicated_sharding(self):
+        """Layout for R: replicated under pure DP; column-sharded over the
+        feature axis under TP (each chip holds R[:, d_shard] — SURVEY.md
+        §3.3; XLA then completes the contraction with one psum over ICI)."""
         if self.mesh is None:
             return None
         from jax.sharding import NamedSharding, PartitionSpec
 
+        if self.feature_axis is not None:
+            return NamedSharding(self.mesh, PartitionSpec(None, self.feature_axis))
         return NamedSharding(self.mesh, PartitionSpec())
 
     def _row_sharding(self):
+        """Layout for X batches: rows over 'data', features over the TP axis
+        when configured."""
         if self.mesh is None:
             return None
         from jax.sharding import NamedSharding, PartitionSpec
 
-        return NamedSharding(self.mesh, PartitionSpec(self.data_axis))
+        return NamedSharding(
+            self.mesh, PartitionSpec(self.data_axis, self.feature_axis)
+        )
 
     # -- ProjectionBackend API ----------------------------------------------
 
@@ -145,31 +187,81 @@ class JaxBackend(ProjectionBackend):
                 )
             return _LazyMask(spec.seed, spec.density if spec.kind == "sparse" else 1.0)
 
+        if self.precision == "split2":
+            if spec.kind not in ("sparse", "rademacher"):
+                raise ValueError(
+                    "precision='split2' relies on the ±1/0 mask being exact "
+                    "in bf16 and supports kind='sparse'/'rademacher' only; "
+                    f"got {spec.kind!r} (use precision='high' for gaussian)"
+                )
+            import math
+
+            key = jax.random.key(spec.seed)
+            density = float(spec.density) if spec.kind == "sparse" else 1.0
+            R = kernels.sparse_matrix(
+                key, spec.n_components, spec.n_features, density, jnp.float32
+            )
+            scale = 1.0 / math.sqrt(density * spec.n_components)
+            # R entries are exactly ±scale (or 0) in f32, so dividing by the
+            # same f32 scale yields exact ±1/0 (IEEE division: a/a == 1)
+            mask = (R / jnp.float32(scale)).astype(jnp.bfloat16)
+            sharding = self._replicated_sharding()
+            if sharding is not None:
+                mask = jax.device_put(mask, sharding)
+            return _SplitMask(mask, scale)
+
         key = jax.random.key(spec.seed)
         dtype = jnp.dtype(self.compute_dtype)
         if spec.kind == "gaussian":
-            R = kernels.gaussian_matrix(key, spec.n_components, spec.n_features, dtype)
+            matrix_fn = kernels.gaussian_matrix
         elif spec.kind == "sparse":
-            R = kernels.sparse_matrix(
-                key, spec.n_components, spec.n_features, float(spec.density), dtype
+            density = float(spec.density)
+            matrix_fn = lambda k_, kc, nf, dt: kernels.sparse_matrix(  # noqa: E731
+                k_, kc, nf, density, dt
             )
         elif spec.kind == "rademacher":
-            R = kernels.rademacher_matrix(
-                key, spec.n_components, spec.n_features, dtype
-            )
+            matrix_fn = kernels.rademacher_matrix
         else:  # pragma: no cover - spec validates kind
             raise ValueError(spec.kind)
-        sharding = self._replicated_sharding()
-        if sharding is not None:
-            R = jax.device_put(R, sharding)
-        return R
+        if self.mesh is not None:
+            # generate directly INTO the mesh layout (out_shardings): under
+            # feature-axis TP each chip materializes only its column shard —
+            # no full-matrix intermediate on any one device (the partition-
+            # able counter PRNG keeps values identical to unsharded)
+            from randomprojection_tpu.parallel.sharded import materialize_sharded
+
+            return materialize_sharded(
+                matrix_fn,
+                key,
+                spec.n_components,
+                spec.n_features,
+                self.mesh,
+                feature_axis=self.feature_axis,
+                dtype=dtype,
+            )
+        return matrix_fn(key, spec.n_components, spec.n_features, dtype)
 
     def _get_transform_fn(self):
         if self._transform_fn is None:
             import jax
             import jax.numpy as jnp
 
-            precision = self.precision
+            precision = self._einsum_precision()
+
+            if self.feature_axis is not None:
+                # TP: contraction dim is sharded — use the explicit
+                # shard_map projector (partial einsum + one psum over ICI)
+                from randomprojection_tpu.parallel.sharded import (
+                    make_sharded_projector,
+                )
+
+                self._transform_fn = make_sharded_projector(
+                    self.mesh,
+                    data_axis=self.data_axis,
+                    feature_axis=self.feature_axis,
+                    precision=precision,
+                )
+                return self._transform_fn
 
             @jax.jit
             def _project(x, r):
@@ -231,9 +323,26 @@ class JaxBackend(ProjectionBackend):
             x = jax.device_put(x, row_sharding)
         return x, n, device_resident
 
+    def _get_split_fn(self):
+        if self._split_fn is None:
+            import jax
+
+            from randomprojection_tpu.ops.split_matmul import split2_project
+
+            @jax.jit
+            def _project_split(x, mask, scale):
+                return split2_project(x, mask, scale).astype(x.dtype)
+
+            self._split_fn = _project_split
+        return self._split_fn
+
     def _transform_impl(self, X, state, spec: ProjectionSpec):
         x, n, device_resident = self._prepare_rows(X)
-        if isinstance(state, _LazyMask):
+        if isinstance(state, _SplitMask):
+            y = self._get_split_fn()(
+                x.astype(self._jax.numpy.float32), state.mask, state.scale
+            ).astype(x.dtype)
+        elif isinstance(state, _LazyMask):
             from randomprojection_tpu.ops.pallas_kernels import (
                 fused_sparse_project,
             )
@@ -266,7 +375,7 @@ class JaxBackend(ProjectionBackend):
         import jax.numpy as jnp
 
         if self._sign_fn is None:
-            precision = self.precision
+            precision = self._einsum_precision()
 
             @jax.jit
             def _sign_project(x, r):
@@ -278,8 +387,8 @@ class JaxBackend(ProjectionBackend):
 
             self._sign_fn = _sign_project
 
-        if isinstance(state, _LazyMask):
-            # lazy path: fused mask-projection, then pack on device
+        if isinstance(state, (_LazyMask, _SplitMask)):
+            # lazy/split paths: compute coordinates, then pack on device
             y_coords, device_resident = self._transform_impl(X, state, spec)
             if self._pack_fn is None:
                 self._pack_fn = jax.jit(
@@ -308,6 +417,8 @@ class JaxBackend(ProjectionBackend):
 
         if isinstance(state, _LazyMask):
             state = self._lazy_matrix(state, spec)
+        elif isinstance(state, _SplitMask):
+            state = state.mask.astype(jnp.float32) * state.scale
         # XLA SVD on the small (k, d) matrix; host copy for serialization
         return np.asarray(jnp.linalg.pinv(state.astype(jnp.float32)))
 
@@ -321,7 +432,7 @@ class JaxBackend(ProjectionBackend):
         y = jnp.asarray(Y, dtype=jnp.dtype(self.compute_dtype))
         inv = jnp.asarray(inverse_components, dtype=jnp.dtype(self.compute_dtype))
         if self._inverse_fn is None:
-            precision = self.precision
+            precision = self._einsum_precision()
 
             @jax.jit
             def _reconstruct(a, b):
@@ -339,4 +450,6 @@ class JaxBackend(ProjectionBackend):
     def components_to_numpy(self, state, spec: ProjectionSpec):
         if isinstance(state, _LazyMask):
             state = self._lazy_matrix(state, spec)
+        elif isinstance(state, _SplitMask):
+            state = state.mask.astype(self._jax.numpy.float32) * state.scale
         return np.asarray(state).astype(spec.np_dtype, copy=False)

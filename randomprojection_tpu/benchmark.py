@@ -31,21 +31,43 @@ def pdist2(a):
     return np.maximum(d2[iu], 1e-30)
 
 
-def measure_mode(jax, jnp, R_f32, dtype, precision, batch, steps, calls, d):
+def _mode_project_fn(jax, jnp, name, scale):
+    """(project(x, r), input_dtype, r_transform) for one MXU mode."""
+    if name == "bf16_split2":
+        from randomprojection_tpu.ops.split_matmul import split2_project
+
+        def project(x, r):  # r is the unscaled ±1/0 mask in bf16
+            return split2_project(x, r, scale)
+
+        def r_prep(R_f32):
+            return (R_f32 / jnp.float32(scale)).astype(jnp.bfloat16)
+
+        return project, jnp.float32, r_prep
+
+    dtype, precision = {
+        "bf16": (jnp.bfloat16, "default"),
+        "f32_high": (jnp.float32, "high"),
+    }[name]
+
+    def project(x, r):
+        return jnp.einsum(
+            "nd,kd->nk", x, r,
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    return project, dtype, lambda R_f32: R_f32.astype(dtype)
+
+
+def measure_mode(jax, jnp, R_f32, name, scale, batch, steps, calls, d):
     """Time the chained-scan projection loop in one MXU mode."""
-    r = R_f32.astype(dtype)
-    x0 = jax.random.normal(jax.random.key(1), (batch, d), dtype=dtype)
+    project, in_dtype, r_prep = _mode_project_fn(jax, jnp, name, scale)
+    r = r_prep(R_f32)
+    x0 = jax.random.normal(jax.random.key(1), (batch, d), dtype=in_dtype)
 
     @jax.jit
     def run_steps(x, r):
         def step(x, _):
-            y = jnp.einsum(
-                "nd,kd->nk",
-                x,
-                r,
-                preferred_element_type=jnp.float32,
-                precision=precision,
-            )
+            y = project(x, r)
             # chain the next input on this output: defeats DCE and
             # identical-argument call caching; numerically negligible
             x = x + (y[:, :1] * 1e-24).astype(x.dtype)
@@ -71,16 +93,12 @@ def measure_mode(jax, jnp, R_f32, dtype, precision, batch, steps, calls, d):
     }
 
 
-def measure_distortion(jax, jnp, R_f32, x_cpu, dtype, precision):
+def measure_distortion(jax, jnp, R_f32, x_cpu, name, scale):
     """Max relative pairwise-distance error vs CPU f64, same R."""
+    project, in_dtype, r_prep = _mode_project_fn(jax, jnp, name, scale)
     xs = x_cpu[:1024]
     y_dev = np.asarray(
-        jax.jit(
-            lambda a, b: jnp.einsum(
-                "nd,kd->nk", a, b, preferred_element_type=jnp.float32,
-                precision=precision,
-            )
-        )(jnp.asarray(xs, dtype=dtype), R_f32.astype(dtype))
+        jax.jit(project)(jnp.asarray(xs, dtype=in_dtype), r_prep(R_f32))
     ).astype(np.float64)
     y_ref = xs.astype(np.float64) @ np.asarray(R_f32, dtype=np.float64).T
     return float(np.max(np.abs(pdist2(y_dev) / pdist2(y_ref) - 1.0)))
@@ -93,20 +111,19 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
 
     from randomprojection_tpu.ops import kernels
 
+    import math
+
     cfg = PRESETS[preset]
     R = kernels.sparse_matrix(jax.random.key(0), k, d, density, jnp.float32)
+    scale = 1.0 / math.sqrt(density * k)
 
     rng = np.random.default_rng(0)
     x_cpu = rng.normal(size=(16384, d)).astype(np.float32)
 
-    modes = {
-        "bf16": (jnp.bfloat16, "default"),
-        "f32_high": (jnp.float32, "high"),
-    }
     results = {}
-    for name, (dtype, precision) in modes.items():
-        perf = measure_mode(jax, jnp, R, dtype, precision, d=d, **cfg)
-        perf["distortion"] = measure_distortion(jax, jnp, R, x_cpu, dtype, precision)
+    for name in ("bf16", "bf16_split2", "f32_high"):
+        perf = measure_mode(jax, jnp, R, name, scale, d=d, **cfg)
+        perf["distortion"] = measure_distortion(jax, jnp, R, x_cpu, name, scale)
         results[name] = perf
 
     eligible = [n for n, r in results.items() if r["distortion"] <= DISTORTION_BUDGET]

@@ -15,6 +15,7 @@ from randomprojection_tpu.models.sketch import (
     SignRandomProjection,
     cosine_from_hamming,
     pairwise_hamming,
+    pairwise_hamming_device,
 )
 
 __all__ = [
@@ -24,5 +25,6 @@ __all__ = [
     "SignRandomProjection",
     "CountSketch",
     "pairwise_hamming",
+    "pairwise_hamming_device",
     "cosine_from_hamming",
 ]

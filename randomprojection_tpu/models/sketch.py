@@ -32,6 +32,7 @@ __all__ = [
     "SignRandomProjection",
     "CountSketch",
     "pairwise_hamming",
+    "pairwise_hamming_device",
     "cosine_from_hamming",
 ]
 
@@ -85,14 +86,56 @@ def pairwise_hamming(A, B=None):
     """Hamming distances between packed sign codes.
 
     ``A: (n1, nbytes)``, ``B: (n2, nbytes)`` (default ``B=A``) → ``(n1, n2)``
-    int32.  Host implementation (np.bitwise_count); for device-side bulk
-    scoring use ``ops.kernels``-style jit with ``lax.population_count``.
+    int32.  Host implementation (np.bitwise_count); use
+    ``pairwise_hamming_device`` for bulk scoring of big code sets on TPU.
     """
     A = np.asarray(A, dtype=np.uint8)
     B = A if B is None else np.asarray(B, dtype=np.uint8)
     return (
         np.bitwise_count(A[:, None, :] ^ B[None, :, :]).sum(-1).astype(np.int32)
     )
+
+
+_HAMMING_TILE_FN = None
+
+
+def _hamming_tile_fn():
+    global _HAMMING_TILE_FN
+    if _HAMMING_TILE_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def tile_fn(a, b):
+            x = jnp.bitwise_xor(a[:, None, :], b[None, :, :])
+            return jax.lax.population_count(x).astype(jnp.int32).sum(-1)
+
+        _HAMMING_TILE_FN = tile_fn
+    return _HAMMING_TILE_FN
+
+
+def pairwise_hamming_device(A, B=None, *, tile: int = 2048):
+    """Device bulk Hamming: XOR + ``lax.population_count``, tiled over A.
+
+    ``A (n1, nbytes)`` uint8 vs ``B (n2, nbytes)`` → ``(n1, n2)`` int32.
+    ``B`` is held on device whole and the dense output is allocated on the
+    host, so this serves query batches against an index that fits HBM
+    (n2·nbytes ≲ GBs) with n1 arbitrarily large via ``tile``.  For an index
+    beyond one chip's HBM, shard B across hosts/chips and merge the tiles —
+    this function is the per-shard primitive, not the sharding.
+    """
+    import jax.numpy as jnp
+
+    A = np.asarray(A, dtype=np.uint8)
+    B = A if B is None else np.asarray(B, dtype=np.uint8)
+    b_dev = jnp.asarray(B)
+    tile_fn = _hamming_tile_fn()
+
+    out = np.empty((A.shape[0], B.shape[0]), dtype=np.int32)
+    for lo in range(0, A.shape[0], tile):
+        hi = min(lo + tile, A.shape[0])
+        out[lo:hi] = np.asarray(tile_fn(jnp.asarray(A[lo:hi]), b_dev))
+    return out
 
 
 def cosine_from_hamming(hamming, n_bits: int):

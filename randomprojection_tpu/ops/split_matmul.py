@@ -1,0 +1,51 @@
+"""Two-pass split-precision projection for exact-in-bf16 mask matrices.
+
+For the sparse (Achlioptas/Li) and sign kernels the *unscaled* matrix
+entries are ``{+1, -1, 0}`` — exactly representable in bf16.  Splitting
+only ``X`` into high/low bf16 halves then gives f32-grade output from two
+single-pass MXU contractions:
+
+    X = X_hi + X_lo   (X_hi = top 16 bits of the f32 mantissa/exponent)
+    Y = (X_hi · Mᵀ + X_lo · Mᵀ) · v
+
+Measured pairwise-distance distortion ~3e-6 (vs ~1.1e-3 for one pass and
+~2.2e-5 for the 3-pass 'high' mode) at 2/3 the cost of 'high' — the
+fastest mode inside the 1e-3 budget for the mask kernels, and the bench's
+headline mode on the BASELINE.json config-2 workload.
+
+The high part is produced by **bit-masking** the f32 mantissa, not by an
+f32→bf16→f32 convert pair: XLA's simplifier elides that convert round-trip,
+which silently zeroes the low part (found empirically; the bitmask form is
+opaque to the simplifier).  Truncation (vs round-to-nearest) is fine: the
+low half absorbs the difference exactly up to its own bf16 rounding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["split_f32_to_bf16_pair", "split2_project"]
+
+
+def split_f32_to_bf16_pair(x):
+    """``x (f32) -> (x_hi, x_lo)`` bf16 with ``x_hi + x_lo == x`` to ~2^-16."""
+    xu = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    x_hi_f32 = jax.lax.bitcast_convert_type(
+        xu & jnp.uint32(0xFFFF0000), jnp.float32
+    )
+    x_hi = x_hi_f32.astype(jnp.bfloat16)  # exact: low mantissa bits are zero
+    x_lo = (x - x_hi_f32).astype(jnp.bfloat16)
+    return x_hi, x_lo
+
+
+def split2_project(x, mask_bf16, scale):
+    """``(x @ mask.T) * scale`` in two bf16 MXU passes, f32-grade accuracy.
+
+    ``x`` f32 ``(n, d)``; ``mask_bf16`` ``(k, d)`` with entries exactly
+    representable in bf16 (``{±1, 0}``); ``scale`` python float.
+    """
+    x_hi, x_lo = split_f32_to_bf16_pair(x.astype(jnp.float32))
+    a = jnp.einsum("nd,kd->nk", x_hi, mask_bf16, preferred_element_type=jnp.float32)
+    b = jnp.einsum("nd,kd->nk", x_lo, mask_bf16, preferred_element_type=jnp.float32)
+    return (a + b) * scale

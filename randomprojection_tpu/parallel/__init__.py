@@ -9,6 +9,7 @@ sequence/context parallelism for this workload (SURVEY.md §6
 is no hand-written networking (SURVEY.md §3.4).
 """
 
+from randomprojection_tpu.parallel import distributed
 from randomprojection_tpu.parallel.mesh import (
     default_mesh,
     make_mesh,
@@ -22,6 +23,7 @@ from randomprojection_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "distributed",
     "default_mesh",
     "make_mesh",
     "mesh_shape_for",

@@ -1,0 +1,91 @@
+"""Multi-host bring-up (SURVEY.md §3.4 process model).
+
+The reference's distributed fabric is a Spark driver plus executors; the
+TPU-native equivalent is SPMD: one Python process per host, every process
+running the same program, `jax.distributed.initialize()` wiring them into
+one runtime whose mesh spans all chips.  Collectives ride ICI within a
+slice and DCN across slices — there is no driver, no RPC layer, and no
+hand-written networking in this framework.
+
+Typical pod usage::
+
+    from randomprojection_tpu.parallel import distributed, default_mesh
+
+    distributed.initialize()            # no-op on single-process runs
+    mesh = default_mesh()               # spans every chip in the job
+    est = GaussianRandomProjection(256, random_state=0, backend="jax",
+                                   backend_options={"mesh": mesh})
+    est.fit_schema(n_rows, d)           # R generated sharding-invariantly
+    for lo, y in est.transform_stream(my_source): ...  # rows of THIS host
+
+Each host streams its own row range (`host_row_range` below): rows are
+independent, so no cross-host coordination is needed beyond the gang-
+scheduled collectives XLA emits.  Failure recovery is restart + cursor
+resume (see `streaming.py`) — SPMD jobs are gang-scheduled, so a lost host
+means the job restarts from checkpoints, exactly like the reference's
+lineage recomputation but with explicit cursors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+__all__ = ["initialize", "is_multi_process", "host_row_range"]
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host runtime; safe no-op for single-process runs.
+
+    With no arguments, relies on the TPU environment's auto-detection
+    (GKE/TPU-VM metadata).  Explicit arguments support manual bring-up.
+    Idempotent: repeated calls after a successful initialize are ignored.
+    """
+    import jax
+
+    if getattr(initialize, "_done", False):
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        initialize._done = True
+    except (ValueError, RuntimeError) as e:
+        # single-process environment (no coordinator configured): fine —
+        # jax.devices() already covers the local chips
+        if num_processes not in (None, 1):
+            raise
+        initialize._done = True
+        import logging
+
+        logging.getLogger("randomprojection_tpu").debug(
+            "jax.distributed.initialize skipped: %s", e
+        )
+
+
+def is_multi_process() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def host_row_range(n_rows: int) -> Tuple[int, int]:
+    """This host's contiguous row slice ``[lo, hi)`` of a global stream.
+
+    Rows are independent in X·Rᵀ, so the natural multi-host decomposition
+    is block-by-process (the Spark partition map's equivalent).  The split
+    is balanced to within one row and every process computes it without
+    communication.
+    """
+    import jax
+
+    p, n_p = jax.process_index(), jax.process_count()
+    base, extra = divmod(n_rows, n_p)
+    lo = p * base + min(p, extra)
+    hi = lo + base + (1 if p < extra else 0)
+    return lo, hi

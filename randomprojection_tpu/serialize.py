@@ -59,6 +59,12 @@ def save_model(est, path: str, *, include_matrix: bool = False) -> None:
             "dense_output": getattr(est, "dense_output", None),
             "compute_inverse_components": est.compute_inverse_components,
         }
+        # the lazy (Pallas PRNG) matrix is a different PRNG family from the
+        # dense threefry one: record it, or a reload would silently
+        # re-materialize a DIFFERENT matrix from the same seed
+        state = getattr(est, "_state", None)
+        if type(state).__name__ == "_LazyMask":
+            payload["backend_options"] = {"materialization": "lazy"}
     else:  # CountSketch: seed-defined, no dense spec
         payload["countsketch"] = {
             "n_components": est.n_components_,
@@ -119,10 +125,18 @@ def load_model(path: str, *, backend: Optional[str] = None):
         kwargs["dense_output"] = params["dense_output"]
     if spec.kind == "sparse":
         kwargs["density"] = spec.density
+    backend_options = payload.get("backend_options") or None
+    if backend_options and backend is not None and backend != "jax":
+        raise ValueError(
+            f"This model was fitted with backend options {backend_options} "
+            f"(a jax-only PRNG family); it cannot be loaded on backend="
+            f"{backend!r} without changing the matrix"
+        )
     est = cls(
         spec.n_components,
         random_state=spec.seed,
-        backend=backend or "auto",
+        backend=backend or ("jax" if backend_options else "auto"),
+        backend_options=backend_options,
         compute_inverse_components=bool(params.get("compute_inverse_components")),
         **kwargs,
     )

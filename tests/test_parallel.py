@@ -83,6 +83,27 @@ def test_replicated_materialization(devices):
     assert R.sharding.is_fully_replicated
 
 
+def test_estimator_with_tp_mesh_backend(devices):
+    """Backend-level DPxTP: R column-sharded, X feature-sharded, GSPMD
+    inserts the psum; output must match the single-device run."""
+    from randomprojection_tpu import GaussianRandomProjection, SparseRandomProjection
+
+    mesh = make_mesh({"data": 4, "feature": 2})
+    X = np.random.default_rng(5).normal(size=(64, 2048)).astype(np.float32)
+    for Est in (GaussianRandomProjection, SparseRandomProjection):
+        est_tp = Est(
+            n_components=16, random_state=1, backend="jax",
+            backend_options={"mesh": mesh, "feature_axis": "feature"},
+        ).fit(X)
+        state = est_tp.components_
+        assert state.sharding.spec == feature_sharded(mesh).spec
+        Y_tp = np.asarray(est_tp.transform(X))
+        est_1 = Est(n_components=16, random_state=1, backend="jax").fit(X)
+        np.testing.assert_allclose(
+            Y_tp, np.asarray(est_1.transform(X)), rtol=1e-4, atol=1e-4
+        )
+
+
 def test_estimator_with_mesh_backend(devices):
     """End-to-end: estimator on a jax backend bound to an 8-device mesh."""
     from randomprojection_tpu import GaussianRandomProjection

@@ -60,6 +60,22 @@ def test_save_with_matrix_bundle(tmp_path):
     assert bundle["inverse_components"].shape == (64, 8)
 
 
+def test_load_lazy_model_refuses_foreign_backend(tmp_path):
+    """A lazy-fitted (Pallas-PRNG) model must not silently re-materialize
+    as a different matrix family on another backend."""
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps({
+        "format_version": 1,
+        "class": "SparseRandomProjection",
+        "spec": {"kind": "sparse", "n_components": 16, "n_features": 64,
+                 "seed": 3, "density": 0.25, "dtype": "float32"},
+        "params": {"dense_output": False, "compute_inverse_components": False},
+        "backend_options": {"materialization": "lazy"},
+    }))
+    with pytest.raises(ValueError, match="cannot be loaded"):
+        load_model(str(p), backend="numpy")
+
+
 def test_load_rejects_bad_version(tmp_path):
     p = tmp_path / "m.json"
     p.write_text(json.dumps({"format_version": 99, "class": "X"}))

@@ -1,0 +1,144 @@
+"""Direct parity against sklearn's random_projection — the on-disk
+behavioral contract ([CAP] in SURVEY.md §0).  These tests pin OUR behavior
+to the canonical implementation wherever the contract is exact, and to
+matched statistics where PRNGs necessarily differ."""
+
+import numpy as np
+import pytest
+
+sklearn_rp = pytest.importorskip("sklearn.random_projection")
+
+from randomprojection_tpu import (
+    GaussianRandomProjection,
+    SparseRandomProjection,
+    johnson_lindenstrauss_min_dim,
+)
+
+
+def test_jl_min_dim_matches_sklearn_exactly():
+    ns = [10, 100, 5000, 10**6]
+    epss = [0.05, 0.1, 0.5, 0.999]
+    for n in ns:
+        for e in epss:
+            assert johnson_lindenstrauss_min_dim(n, eps=e) == int(
+                sklearn_rp.johnson_lindenstrauss_min_dim(n, eps=e)
+            ), (n, e)
+    # array broadcasting parity
+    np.testing.assert_array_equal(
+        johnson_lindenstrauss_min_dim(np.array(ns), eps=0.3),
+        sklearn_rp.johnson_lindenstrauss_min_dim(np.array(ns), eps=0.3),
+    )
+
+
+def test_jl_min_dim_32bit_regression():
+    # TRP.py:451-456: the bound must not overflow 32-bit ints
+    assert johnson_lindenstrauss_min_dim(100, eps=1e-5) == 368416070986
+
+
+def test_auto_dim_resolution_matches_sklearn():
+    X = np.zeros((10, 1000))
+    ours = SparseRandomProjection(n_components="auto", eps=0.5, random_state=0,
+                                  backend="numpy").fit(X)
+    theirs = sklearn_rp.SparseRandomProjection(
+        n_components="auto", eps=0.5, random_state=0
+    ).fit(X)
+    assert ours.n_components_ == theirs.n_components_ == 110
+    assert ours.density_ == pytest.approx(theirs.density_)
+
+
+def test_gaussian_matrix_statistics_match_sklearn():
+    """Different PRNGs ⇒ statistical parity: mean, variance, row norms."""
+    X = np.zeros((10, 2000))
+    k = 500
+    ours = GaussianRandomProjection(k, random_state=0, backend="numpy").fit(X)
+    theirs = sklearn_rp.GaussianRandomProjection(k, random_state=0).fit(X)
+    Ro, Rt = np.asarray(ours.components_), np.asarray(theirs.components_)
+    assert Ro.shape == Rt.shape == (k, 2000)
+    assert abs(Ro.mean() - Rt.mean()) < 1e-3
+    np.testing.assert_allclose(Ro.var(), Rt.var(), rtol=0.02)
+    np.testing.assert_allclose(
+        np.linalg.norm(Ro, axis=1).mean(),
+        np.linalg.norm(Rt, axis=1).mean(),
+        rtol=0.02,
+    )
+
+
+def test_sparse_matrix_statistics_match_sklearn():
+    import scipy.sparse as sp
+
+    X = np.zeros((10, 2000))
+    k = 400
+    ours = SparseRandomProjection(k, density=0.1, random_state=0,
+                                  backend="numpy").fit(X)
+    theirs = sklearn_rp.SparseRandomProjection(k, density=0.1,
+                                               random_state=0).fit(X)
+    Ro, Rt = ours.components_, theirs.components_
+    assert sp.issparse(Ro) and sp.issparse(Rt)
+    # same value set
+    np.testing.assert_allclose(
+        np.unique(np.abs(Ro.data)), np.unique(np.abs(Rt.data)), rtol=1e-12
+    )
+    # same nnz rate within sampling noise
+    np.testing.assert_allclose(Ro.nnz, Rt.nnz, rtol=0.03)
+
+
+def test_transform_agrees_with_sklearn_given_same_matrix():
+    """With identical R, our transform must be numerically identical
+    (same BLAS on the numpy backend)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(100, 300))
+    theirs = sklearn_rp.GaussianRandomProjection(32, random_state=0).fit(X)
+    ours = GaussianRandomProjection(32, random_state=0, backend="numpy").fit(X)
+    # graft sklearn's matrix into our fitted state
+    ours._state = np.ascontiguousarray(theirs.components_)
+    np.testing.assert_allclose(
+        ours.transform(X), theirs.transform(X), rtol=1e-12, atol=1e-12
+    )
+
+
+def test_warning_and_error_conditions_match_sklearn():
+    from randomprojection_tpu import DataDimensionalityWarning
+
+    X = np.ones((1000, 100))
+    with pytest.raises(ValueError):
+        GaussianRandomProjection("auto", eps=0.1, backend="numpy").fit(X)
+    with pytest.raises(ValueError):
+        sklearn_rp.GaussianRandomProjection("auto", eps=0.1).fit(X)
+    with pytest.warns(DataDimensionalityWarning):
+        GaussianRandomProjection(200, random_state=0, backend="numpy").fit(
+            np.ones((10, 100))
+        )
+    with pytest.warns(Warning):
+        sklearn_rp.GaussianRandomProjection(200, random_state=0).fit(
+            np.ones((10, 100))
+        )
+
+
+def test_inverse_transform_parity():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(80, 200))
+    ours = GaussianRandomProjection(
+        40, random_state=0, backend="numpy", compute_inverse_components=True
+    ).fit(X)
+    theirs = sklearn_rp.GaussianRandomProjection(
+        40, random_state=0, compute_inverse_components=True
+    ).fit(X)
+    # identical algebra: graft their matrix and inverse into ours
+    ours._state = np.ascontiguousarray(theirs.components_)
+    ours.inverse_components_ = np.ascontiguousarray(theirs.inverse_components_)
+    Y = theirs.transform(X)
+    np.testing.assert_allclose(
+        ours.inverse_transform(Y), theirs.inverse_transform(Y),
+        rtol=1e-10, atol=1e-12,
+    )
+
+
+def test_device_hamming_matches_host():
+    from randomprojection_tpu import pairwise_hamming, pairwise_hamming_device
+
+    rng = np.random.default_rng(0)
+    A = rng.integers(0, 256, size=(300, 16), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(70, 16), dtype=np.uint8)
+    np.testing.assert_array_equal(
+        pairwise_hamming_device(A, B, tile=128), pairwise_hamming(A, B)
+    )

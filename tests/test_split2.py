@@ -1,0 +1,118 @@
+"""Split-precision (2-pass bf16) mode tests — plain XLA, runs on CPU."""
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu import SignRandomProjection, SparseRandomProjection
+
+
+def pdist2(a):
+    sq = (a * a).sum(1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (a @ a.T)
+    iu = np.triu_indices(a.shape[0], k=1)
+    return np.maximum(d2[iu], 1e-30)
+
+
+def test_split_pair_reconstructs_exactly():
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.ops.split_matmul import split_f32_to_bf16_pair
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 256)),
+                    dtype=jnp.float32)
+    hi, lo = split_f32_to_bf16_pair(x)
+    # the low half must be NON-zero (the XLA convert-elision trap) ...
+    assert float(jnp.abs(lo.astype(jnp.float32)).max()) > 0
+    # ... and hi+lo must reconstruct x to ~2^-16 relative
+    recon = hi.astype(jnp.float32) + lo.astype(jnp.float32)
+    err = np.abs(np.asarray(recon) - np.asarray(x)).max()
+    assert err < np.abs(np.asarray(x)).max() * 2**-15
+
+
+@pytest.mark.parametrize("density", [1.0, 1 / 3, 0.1])
+def test_split2_backend_accuracy(density):
+    """split2 output must track the exact f64 product to ~1e-5 distances."""
+    X = np.random.default_rng(0).normal(size=(256, 1024)).astype(np.float32)
+    est = SparseRandomProjection(
+        n_components=64, density=density, random_state=0, backend="jax",
+        backend_options={"precision": "split2"},
+    ).fit(X)
+    Y = np.asarray(est.transform(X), dtype=np.float64)
+    R = np.asarray(est.components_as_numpy(), dtype=np.float64)
+    Y_ref = X.astype(np.float64) @ R.T
+    dist_err = np.abs(pdist2(Y) / pdist2(Y_ref) - 1.0).max()
+    assert dist_err < 1e-4, dist_err
+
+
+def test_split2_mask_values_exact():
+    est = SparseRandomProjection(
+        n_components=32, density=1 / 3, random_state=1, backend="jax",
+        backend_options={"precision": "split2"},
+    ).fit(np.zeros((10, 512), dtype=np.float32))
+    state = est.components_
+    mask = np.asarray(state.mask, dtype=np.float64)
+    assert set(np.unique(mask)) <= {-1.0, 0.0, 1.0}
+    R = est.components_as_numpy()
+    v = 1.0 / np.sqrt((1 / 3) * 32)
+    np.testing.assert_allclose(np.unique(np.abs(R[R != 0])), [v], rtol=1e-6)
+
+
+def test_split2_determinism_and_matches_dense_state():
+    """Same seed: split2 and dense materialization hold the same matrix."""
+    X = np.random.default_rng(2).normal(size=(100, 512)).astype(np.float32)
+    kw = dict(n_components=32, density=0.25, random_state=3, backend="jax")
+    est_split = SparseRandomProjection(
+        **kw, backend_options={"precision": "split2"}
+    ).fit(X)
+    est_dense = SparseRandomProjection(**kw).fit(X)
+    np.testing.assert_allclose(
+        est_split.components_as_numpy(), est_dense.components_as_numpy(),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(est_split.transform(X)), np.asarray(est_dense.transform(X)),
+        rtol=1e-3, atol=1e-3,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(est_split.transform(X)), np.asarray(est_split.transform(X))
+    )
+
+
+def test_split2_sign_rp_packed():
+    X = np.random.default_rng(0).normal(size=(50, 256)).astype(np.float32)
+    # SignRandomProjection is gaussian-kind → split2 must refuse
+    with pytest.raises(ValueError, match="split2"):
+        SignRandomProjection(
+            64, random_state=0, backend="jax",
+            backend_options={"precision": "split2"},
+        ).fit(X)
+
+
+def test_split2_rejects_gaussian():
+    from randomprojection_tpu import GaussianRandomProjection
+
+    with pytest.raises(ValueError, match="split2"):
+        GaussianRandomProjection(
+            8, random_state=0, backend="jax",
+            backend_options={"precision": "split2"},
+        ).fit(np.zeros((10, 64), dtype=np.float32))
+
+
+def test_split2_inverse_roundtrip():
+    X = np.random.default_rng(1).normal(size=(128, 512)).astype(np.float32)
+    est = SparseRandomProjection(
+        n_components=48, density=1 / 3, random_state=0, backend="jax",
+        backend_options={"precision": "split2"},
+    ).fit(X)
+    Y = np.asarray(est.transform(X))
+    Xhat = est.inverse_transform(Y)
+    np.testing.assert_allclose(
+        np.asarray(est.transform(Xhat)), Y, rtol=1e-2, atol=1e-3
+    )
+
+
+def test_invalid_precision_rejected():
+    from randomprojection_tpu.backends.jax_backend import JaxBackend
+
+    with pytest.raises(ValueError, match="precision"):
+        JaxBackend(precision="bogus")
