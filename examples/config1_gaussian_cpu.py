@@ -1,0 +1,45 @@
+"""Config 1 (BASELINE.json:7): Gaussian RP 10k×512→64, dense, single host.
+
+The "PR1 reference" workload: the numpy backend is the reference executor,
+and the JL distance contract is checked on the output.
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from randomprojection_tpu import GaussianRandomProjection
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", choices=["small", "full"], default="full")
+    ap.add_argument("--backend", default="numpy")
+    args = ap.parse_args()
+    n, d, k = (10_000, 512, 64) if args.scale == "full" else (1000, 512, 64)
+
+    X = np.random.default_rng(0).normal(size=(n, d)).astype(np.float32)
+    rp = GaussianRandomProjection(k, random_state=0, backend=args.backend)
+    rp.fit(X)
+    t0 = time.perf_counter()
+    Y = np.asarray(rp.transform(X))
+    dt = time.perf_counter() - t0
+
+    # distance preservation on a sample
+    idx = np.random.default_rng(1).choice(n, size=200, replace=False)
+    dx = np.linalg.norm(X[idx, None] - X[None, idx], axis=-1) ** 2
+    dy = np.linalg.norm(Y[idx, None] - Y[None, idx], axis=-1) ** 2
+    iu = np.triu_indices(len(idx), 1)
+    ratio = dy[iu] / np.maximum(dx[iu], 1e-12)
+    print(
+        f"config1 [{args.backend}]: {n}x{d}->{k}  {n/dt:,.0f} rows/s  "
+        f"distance ratio mean={ratio.mean():.3f} "
+        f"[{ratio.min():.2f}, {ratio.max():.2f}]"
+    )
+
+
+if __name__ == "__main__":
+    main()

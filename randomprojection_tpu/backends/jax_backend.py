@@ -37,6 +37,14 @@ from randomprojection_tpu.backends.base import ProjectionBackend, ProjectionSpec
 __all__ = ["JaxBackend"]
 
 
+def _matrix_key(jax, seed: int):
+    """Seed → matrix-stream key, salted so a user who draws their data from
+    ``jax.random.key(s)`` and fits with ``random_state=s`` cannot collide
+    with the matrix stream (see numpy_backend._STREAM_SALT for the numpy
+    analog and the war story)."""
+    return jax.random.fold_in(jax.random.key(seed), 0x5250)
+
+
 def _pad_rows(n: int) -> int:
     """Bucket a row count to bound jit recompiles: next power of two, ≥ 8."""
     return max(8, 1 << (n - 1).bit_length())
@@ -124,6 +132,7 @@ class JaxBackend(ProjectionBackend):
         self._sign_fn = None
         self._pack_fn = None
         self._split_fn = None
+        self._slice_fns = {}
 
     def _einsum_precision(self) -> str:
         """Precision for plain einsums ('split2' applies only to the mask
@@ -196,7 +205,7 @@ class JaxBackend(ProjectionBackend):
                 )
             import math
 
-            key = jax.random.key(spec.seed)
+            key = _matrix_key(jax, spec.seed)
             density = float(spec.density) if spec.kind == "sparse" else 1.0
             R = kernels.sparse_matrix(
                 key, spec.n_components, spec.n_features, density, jnp.float32
@@ -210,7 +219,7 @@ class JaxBackend(ProjectionBackend):
                 mask = jax.device_put(mask, sharding)
             return _SplitMask(mask, scale)
 
-        key = jax.random.key(spec.seed)
+        key = _matrix_key(jax, spec.seed)
         dtype = jnp.dtype(self.compute_dtype)
         if spec.kind == "gaussian":
             matrix_fn = kernels.gaussian_matrix
@@ -336,6 +345,24 @@ class JaxBackend(ProjectionBackend):
             self._split_fn = _project_split
         return self._split_fn
 
+    def _slice_rows(self, y, n: int):
+        """Drop pad rows.  On a mesh, eager slicing of a sharded array can
+        hit ambiguous-sharding gather rules; slice under jit with an explicit
+        row-sharded out_sharding instead (cached per row count)."""
+        if y.shape[0] == n:
+            return y
+        if self.mesh is None:
+            return y[:n]
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        fn = self._slice_fns.get(n)
+        if fn is None:
+            out_sh = NamedSharding(self.mesh, PartitionSpec(self.data_axis, None))
+            fn = jax.jit(lambda a: a[:n], out_shardings=out_sh)
+            self._slice_fns[n] = fn
+        return fn(y)
+
     def _transform_impl(self, X, state, spec: ProjectionSpec):
         x, n, device_resident = self._prepare_rows(X)
         if isinstance(state, _SplitMask):
@@ -360,7 +387,7 @@ class JaxBackend(ProjectionBackend):
             ).astype(x.dtype)
         else:
             y = self._get_transform_fn()(x, state)
-        return y[:n], device_resident
+        return self._slice_rows(y, n), device_resident
 
     def transform_packed_signs(
         self, X, state, spec: ProjectionSpec, *, materialize: bool = True
@@ -397,7 +424,7 @@ class JaxBackend(ProjectionBackend):
             y = self._pack_fn(y_coords)
         else:
             x, n, device_resident = self._prepare_rows(X)
-            y = self._sign_fn(x, state)[:n]
+            y = self._slice_rows(self._sign_fn(x, state), n)
         if device_resident or not materialize:
             return y
         return np.asarray(y)

@@ -22,13 +22,21 @@ from randomprojection_tpu.ops.numpy_kernels import (
 __all__ = ["NumpyBackend"]
 
 
+#: Salt mixed into the seed before deriving the matrix stream.  Without it,
+#: a user who generated their data with ``default_rng(s)`` and fit with
+#: ``random_state=s`` would get R equal to the first k rows of their own X
+#: (same generator, same stream) — silently breaking the JL guarantee with
+#: pathological self-projection distortions.  Found the hard way.
+_STREAM_SALT = 0x52503141  # "RP1A"
+
+
 class NumpyBackend(ProjectionBackend):
     """Single-host CPU executor: ndarray / CSR state, BLAS matmuls."""
 
     name = "numpy"
 
     def materialize(self, spec: ProjectionSpec):
-        rng = np.random.default_rng(spec.seed)
+        rng = np.random.default_rng(np.random.SeedSequence([_STREAM_SALT, spec.seed]))
         if spec.kind == "gaussian":
             R = gaussian_random_matrix(spec.n_components, spec.n_features, rng)
         elif spec.kind == "sparse":

@@ -25,10 +25,25 @@ def _add_common(p):
     p.add_argument("--backend", default="auto",
                    choices=["auto", "numpy", "jax"])
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--precision", default=None,
+                   choices=["default", "high", "highest", "split2"],
+                   help="jax backend MXU precision mode")
+    p.add_argument("--materialization", default=None,
+                   choices=["dense", "lazy"],
+                   help="jax backend: 'lazy' = in-kernel mask (TPU only)")
     p.add_argument("--log-level", default="warning",
                    choices=["debug", "info", "warning", "error"])
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
+
+
+def _backend_options(args) -> dict:
+    opts = {}
+    if getattr(args, "precision", None):
+        opts["precision"] = args.precision
+    if getattr(args, "materialization", None):
+        opts["materialization"] = args.materialization
+    return opts
 
 
 def build_parser():
@@ -100,6 +115,9 @@ def _make_estimator(args):
     if k != "auto":
         k = int(k)
     common = dict(random_state=args.seed, backend=args.backend)
+    opts = _backend_options(args)
+    if opts:
+        common["backend_options"] = opts
     if args.kind == "gaussian":
         return rp.GaussianRandomProjection(k, eps=args.eps, **common)
     if args.kind == "sparse":

@@ -169,7 +169,10 @@ class CountSketch:
         if n_features <= 0:
             raise ValueError(f"n_features must be strictly positive, got {n_features}")
         self.seed_ = _resolve_seed(self.random_state)
-        rng = np.random.default_rng(self.seed_)
+        # salted stream: a user sharing one seed between their data generator
+        # and the sketch must not get h_/s_ correlated with their data (see
+        # backends/numpy_backend.py::_STREAM_SALT)
+        rng = np.random.default_rng(np.random.SeedSequence([0x43534B31, self.seed_]))
         self.n_components_ = self.n_components
         self.n_features_in_ = n_features
         self.h_ = rng.integers(0, self.n_components, size=n_features, dtype=np.int32)

@@ -171,6 +171,27 @@ def test_determinism(Est, backend):
     np.testing.assert_array_equal(Y1, np.asarray(est2.transform(X)))
 
 
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_matrix_stream_independent_of_data_stream(backend):
+    """Using one seed for BOTH the data generator and random_state must not
+    correlate R with the data (regression: unsalted streams made R equal
+    the first k rows of X, inflating self-projection distances 5x)."""
+    n, d, k = 2000, 256, 32
+    X = np.random.default_rng(0).normal(size=(n, d)).astype(np.float32)
+    est = GaussianRandomProjection(k, random_state=0, backend=backend).fit(X)
+    Y = np.asarray(est.transform(X))
+    # per-row norm ratio ~ chi2_k/k: no row may blow past a ~6-sigma bound
+    ratio = (Y**2).sum(1) / (X**2).sum(1)
+    assert ratio.max() < 1 + 8 * np.sqrt(2 / k), ratio.max()
+    # and R must not be a scaled copy of any leading X rows
+    R = np.asarray(est.components_as_numpy())
+    corr = np.abs(
+        (R / np.linalg.norm(R, axis=1, keepdims=True))
+        @ (X[:k] / np.linalg.norm(X[:k], axis=1, keepdims=True)).T
+    )
+    assert corr.max() < 0.5, corr.max()
+
+
 def test_unseeded_refits_differ_but_are_reproducible():
     X, _ = make_data(30, 200)
     a = GaussianRandomProjection(n_components=8, backend="numpy").fit(X)

@@ -89,7 +89,8 @@ def test_estimator_with_tp_mesh_backend(devices):
     from randomprojection_tpu import GaussianRandomProjection, SparseRandomProjection
 
     mesh = make_mesh({"data": 4, "feature": 2})
-    X = np.random.default_rng(5).normal(size=(64, 2048)).astype(np.float32)
+    # 1000 rows: ragged vs the row bucket, exercising the sharded pad-slice
+    X = np.random.default_rng(5).normal(size=(1000, 2048)).astype(np.float32)
     for Est in (GaussianRandomProjection, SparseRandomProjection):
         est_tp = Est(
             n_components=16, random_state=1, backend="jax",
