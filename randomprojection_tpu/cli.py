@@ -133,9 +133,16 @@ def _make_estimator(args):
 
 
 def cmd_project(args):
+    import os
+
     import scipy.sparse as sp
 
-    from randomprojection_tpu.streaming import ArraySource, stream_to_array
+    from randomprojection_tpu.streaming import (
+        ArraySource,
+        StreamCursor,
+        stream_to_array,
+        stream_transform,
+    )
     from randomprojection_tpu.utils.observability import (
         StreamStats,
         profile_trace,
@@ -148,15 +155,99 @@ def cmd_project(args):
     source = ArraySource(X, args.batch_rows)
     est = _make_estimator(args).fit_source(source)
     stats = StreamStats(log_every=10)
-    with profile_trace(args.profile_dir):
-        Y = stream_to_array(
-            est, source, checkpoint_path=args.checkpoint, stats=stats
+    # np.save appends .npy itself; normalize once so the JSON summary and
+    # the memmap path always name the file that actually exists
+    out_path = args.output if args.output.endswith(".npy") else args.output + ".npy"
+
+    if args.checkpoint is None:
+        with profile_trace(args.profile_dir):
+            Y = stream_to_array(est, source, stats=stats)
+        if sp.issparse(Y):
+            Y = Y.toarray()
+        np.save(out_path, Y)
+        print(json.dumps({"output": out_path, "shape": list(Y.shape),
+                          "dtype": str(Y.dtype), **stats.summary()}))
+        return
+
+    # Checkpointed runs write through an on-disk .npy memmap so every
+    # committed batch is durable: a mid-run crash resumes from the cursor
+    # into the same file, and a completed run is never silently overwritten.
+    # A fingerprint sidecar pins the run configuration: resuming with
+    # different parameters would silently mix two projections in one file.
+    fingerprint = {
+        "kind": args.kind, "n_components": est.n_components_,
+        "seed": args.seed, "density": str(getattr(args, "density", "auto")),
+        "backend": args.backend, "batch_rows": args.batch_rows,
+        "precision": getattr(args, "precision", None),
+        "materialization": getattr(args, "materialization", None),
+        "n_rows": source.n_rows, "n_features": source.n_features,
+        "output": os.path.abspath(out_path),
+    }
+    meta_path = args.checkpoint + ".meta.json"
+    rows_done = (
+        StreamCursor.load(args.checkpoint).rows_done
+        if os.path.exists(args.checkpoint)
+        else 0
+    )
+    if rows_done > 0 and os.path.exists(meta_path):
+        with open(meta_path) as f:
+            recorded = json.load(f)
+        if recorded != fingerprint:
+            diff = {
+                kk: (recorded.get(kk), fingerprint.get(kk))
+                for kk in sorted(set(recorded) | set(fingerprint))
+                if recorded.get(kk) != fingerprint.get(kk)
+            }
+            raise SystemExit(
+                f"checkpoint {args.checkpoint} was written by a run with "
+                f"different parameters {diff} (recorded, requested); "
+                f"resuming would mix two projections in one output — "
+                f"delete the checkpoint to restart"
+            )
+    if rows_done >= source.n_rows and rows_done > 0:
+        raise SystemExit(
+            f"checkpoint {args.checkpoint} records a completed run "
+            f"(rows_done={rows_done}); refusing to overwrite {out_path} — "
+            f"delete the checkpoint file to re-project from scratch"
         )
-    if sp.issparse(Y):
-        Y = Y.toarray()
-    np.save(args.output, Y)
-    print(json.dumps({"output": args.output, "shape": list(Y.shape),
-                      "dtype": str(Y.dtype), **stats.summary()}))
+    out = None
+    if rows_done > 0:
+        if not os.path.exists(out_path):
+            raise SystemExit(
+                f"checkpoint {args.checkpoint} records partial progress "
+                f"(rows_done={rows_done}) but {out_path} does not exist; "
+                f"delete the checkpoint to restart"
+            )
+        out = np.lib.format.open_memmap(out_path, mode="r+")
+        if out.shape[0] != source.n_rows:
+            raise SystemExit(
+                f"{out_path} has {out.shape[0]} rows but the input has "
+                f"{source.n_rows}; it belongs to a different run"
+            )
+    else:
+        with open(meta_path, "w") as f:
+            json.dump(fingerprint, f)
+    with profile_trace(args.profile_dir):
+        for lo, y in stream_transform(
+            est, source, checkpoint_path=args.checkpoint, stats=stats
+        ):
+            if sp.issparse(y):
+                y = y.toarray()
+            if out is None:
+                out = np.lib.format.open_memmap(
+                    out_path, mode="w+", dtype=y.dtype,
+                    shape=(source.n_rows, y.shape[1]),
+                )
+            out[lo : lo + y.shape[0]] = y
+            out.flush()  # durable before the cursor commits this batch
+    if out is None:  # 0-row input: nothing streamed, emit the empty file
+        out = np.lib.format.open_memmap(
+            out_path, mode="w+",
+            dtype=est._stream_out_dtype() or np.float64,
+            shape=(source.n_rows, est._stream_out_width()),
+        )
+    print(json.dumps({"output": out_path, "shape": list(out.shape),
+                      "dtype": str(out.dtype), **stats.summary()}))
 
 
 def cmd_bench(args):

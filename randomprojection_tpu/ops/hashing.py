@@ -75,14 +75,30 @@ def murmur3_32(data, seed: int = 0, *, signed: bool = True) -> int:
     return h
 
 
+def _reject_token(t):
+    raise TypeError(
+        f"Feature names must be str or bytes, got {type(t).__name__}: {t!r}"
+    )
+
+
 def hash_tokens(tokens: Iterable, n_features: int, seed: int = 0):
     """Batch-hash tokens → ``(idx int32, sign int8)`` arrays.
 
     Uses the C++ batch kernel on one concatenated buffer (one FFI call for
     the whole batch), falling back to per-token Python hashing.
+
+    Tokens must be ``str`` or ``bytes`` (sklearn ``FeatureHasher`` contract:
+    non-string feature names raise ``TypeError`` — an int token passed to
+    ``bytes()`` would silently become that many zero bytes, collapsing all
+    equal-valued ints into one bucket).
     """
     encoded = [
-        t.encode("utf-8") if isinstance(t, str) else bytes(t) for t in tokens
+        t.encode("utf-8")
+        if isinstance(t, str)
+        else bytes(t)
+        if isinstance(t, (bytes, bytearray))
+        else _reject_token(t)
+        for t in tokens
     ]
     n = len(encoded)
     idx = np.empty(n, dtype=np.int32)

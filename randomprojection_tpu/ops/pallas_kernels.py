@@ -22,6 +22,14 @@ cross-family parity holds at the distance-distortion level only.
 The mask is generated as exact ``{+1, -1, 0}`` values and the common scale
 ``v = sqrt(1/(density·k))`` is applied once to the accumulated output, so
 mask quantization contributes zero error regardless of MXU precision.
+
+.. warning:: ``BLOCK_D``, the ``(seed, block)`` seeding scheme, and
+   ``_uniform_from_bits`` are part of the persisted-model format: any change
+   silently redefines every saved lazy model.  The structural half of the
+   contract is guarded by the always-on CPU tests
+   (``tests/test_pallas.py::test_structural_invariants_everywhere``); the
+   value half needs the real chip — run ``RP_TEST_TPU=1 pytest
+   tests/test_pallas.py`` before changing any of them.
 """
 
 from __future__ import annotations

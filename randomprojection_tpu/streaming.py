@@ -165,9 +165,10 @@ class StreamCursor:
     """Resumable position in a stream; serializes to a tiny JSON file.
 
     ``rows_done`` always lands on a batch boundary — a batch is committed
-    only after its output is materialized on the host, so a crash between
-    batches loses at most in-flight (uncommitted) work, which the resume
-    recomputes identically.
+    only after the *consumer* has finished processing it (control returned
+    from the yield), so a crash at any point — inside the transform, or
+    inside the consumer's write of the current batch — loses at most
+    uncommitted work, which the resume recomputes identically.
     """
 
     rows_done: int = 0
@@ -199,9 +200,11 @@ def stream_transform(
     """Project a stream, yielding ``(start_row, Y_batch)`` in row order.
 
     ``estimator`` is a fitted projection estimator (any backend).  Pass a
-    ``cursor`` (or a ``checkpoint_path`` holding one) to resume; the cursor
-    is advanced as batches are *committed* (host-materialized), and saved
-    to ``checkpoint_path`` after each commit when given.
+    ``cursor`` (or a ``checkpoint_path`` holding one) to resume; batch i's
+    cursor is advanced (and saved to ``checkpoint_path`` when given) only
+    once the consumer asks for batch i+1 — acknowledging that batch i's
+    yielded output was handled — so a crash inside the consumer never
+    drops a row range on resume.
 
     ``pipeline_depth`` > 1 keeps that many batches in flight on the jax
     backend (double buffering); the numpy backend is synchronous and
@@ -223,18 +226,28 @@ def stream_transform(
 
     pending: list = []  # [(start_row, n_rows, Y_lazy, in_nbytes)]
 
-    def commit(entry):
+    def materialize(entry):
         start_row, n_rows, y, in_nbytes = entry
         if not sp.issparse(y):  # forces device→host for lazy handles
             y = np.asarray(y)
             if out_dtype is not None:
                 y = y.astype(out_dtype, copy=False)
+        if stats is not None:
+            stats.on_commit(start_row, in_nbytes, y)
+        return start_row, n_rows, y
+
+    def emit(entry):
+        # Yield the batch FIRST; advance/save the cursor only after control
+        # returns from the yield — i.e. after the consumer's loop body (the
+        # canonical write-output-after-yield usage) has completed for this
+        # batch.  Saving before the yield would let a crash inside the
+        # consumer silently drop the batch's row range on resume: the cursor
+        # would claim rows the consumer never durably wrote.
+        start_row, n_rows, y = materialize(entry)
+        yield start_row, y
         cursor.rows_done = start_row + n_rows
         if checkpoint_path is not None:
             cursor.save(checkpoint_path)
-        if stats is not None:
-            stats.on_commit(start_row, in_nbytes, y)
-        return start_row, y
 
     for start_row, batch in source.iter_batches(cursor.rows_done):
         # _transform_async is each estimator's own (possibly overridden)
@@ -244,9 +257,9 @@ def stream_transform(
         # pipeline_depth extra input batches of host memory
         pending.append((start_row, batch.shape[0], y, getattr(batch, "nbytes", 0)))
         if len(pending) >= pipeline_depth:
-            yield commit(pending.pop(0))
+            yield from emit(pending.pop(0))
     while pending:
-        yield commit(pending.pop(0))
+        yield from emit(pending.pop(0))
 
 
 def stream_to_array(estimator, source, out=None, **kwargs) -> np.ndarray:

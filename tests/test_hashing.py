@@ -81,6 +81,21 @@ def test_feature_hasher_validation():
         FeatureHasher(input_type="nope")
 
 
+def test_non_string_tokens_raise_type_error():
+    """sklearn FeatureHasher contract: feature names must be str/bytes.
+    (bytes(int) would silently turn n into n zero bytes — every equal int
+    collapsing to one bucket.)"""
+    with pytest.raises(TypeError, match="str or bytes"):
+        hash_tokens([5], 64)
+    with pytest.raises(TypeError, match="str or bytes"):
+        hash_tokens(["ok", 3.5], 64)
+    with pytest.raises(TypeError, match="str or bytes"):
+        FeatureHasher(n_features=64, input_type="string").transform([[1, 2]])
+    # bytes and bytearray both pass through as raw bytes
+    idx_b, _ = hash_tokens([b"tok", bytearray(b"tok")], 64)
+    assert idx_b[0] == idx_b[1]
+
+
 def test_feature_hasher_feeds_countsketch():
     """Config 5 end-to-end: raw docs → hashed CSR → CountSketch → dense."""
     from randomprojection_tpu import CountSketch

@@ -83,8 +83,8 @@ def test_block_streams_differ():
     assert not np.array_equal(R[:, :BLOCK_D], R[:, BLOCK_D:])
 
 
-@requires_tpu
 def test_validation():
+    """Argument validation fires before pallas_call — runs on any backend."""
     import jax.numpy as jnp
 
     from randomprojection_tpu.ops.pallas_kernels import fused_sparse_project
@@ -94,6 +94,61 @@ def test_validation():
         fused_sparse_project(x, 0, 12, 0.5)
     with pytest.raises(ValueError, match="density"):
         fused_sparse_project(x, 0, 16, 1.5)
+
+
+def test_structural_invariants_everywhere():
+    """Shape/padding/seed-folding contracts, checked WITHOUT executing the
+    kernel (abstract eval only), so the default CPU suite guards them.
+
+    These are load-bearing for persisted lazy models: the (seed, block)
+    streams, BLOCK_D, and the pad-then-slice layout define the matrix.
+    Changing any of them silently redefines every saved lazy model — run
+    RP_TEST_TPU=1 pytest tests/test_pallas.py before touching BLOCK_D,
+    the PRNG seeding, or _uniform_from_bits.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.ops.pallas_kernels import (
+        BLOCK_D,
+        BLOCK_N,
+        fused_sparse_project,
+        pallas_sparse_matrix,
+        _seed_to_i32,
+    )
+
+    # the matrix definition constants themselves (serialization depends on
+    # them; a changed value must fail here, not silently re-key models)
+    assert BLOCK_D == 512 and BLOCK_N == 256
+
+    # seed folding: mod 2^32 then signed int32 reinterpretation
+    assert _seed_to_i32(0) == 0
+    assert _seed_to_i32(5) == 5
+    assert _seed_to_i32(2**31) == -(2**31)
+    assert _seed_to_i32(2**32 + 7) == 7
+    assert _seed_to_i32(-1) == -1
+
+    # ragged n and d are padded to (block_n, BLOCK_D) multiples internally
+    # and sliced back: output shape must be exact for any input shape
+    for n, d, k in [(300, 700, 32), (1, 1, 8), (256, 512, 64), (257, 513, 8)]:
+        out = jax.eval_shape(
+            lambda a, k=k: fused_sparse_project(a, 0, k, 0.5),
+            jax.ShapeDtypeStruct((n, d), jnp.float32),
+        )
+        assert out.shape == (n, k) and out.dtype == jnp.float32
+        R = jax.eval_shape(
+            lambda k=k, d=d: pallas_sparse_matrix(0, k, d, 0.5)
+        )
+        assert R.shape == (k, d) and R.dtype == jnp.float32
+
+    # row tile is NOT part of the matrix definition: changing block_n must
+    # not change the output contract (shape here; values on TPU in
+    # test_determinism_and_row_tile_independence)
+    out = jax.eval_shape(
+        lambda a: fused_sparse_project(a, 0, 32, 0.5, block_n=128),
+        jax.ShapeDtypeStruct((300, 700), jnp.float32),
+    )
+    assert out.shape == (300, 32)
 
 
 @requires_tpu

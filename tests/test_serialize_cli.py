@@ -177,6 +177,62 @@ def test_cli_info():
     assert info["native_murmur3"] is True
 
 
+def test_cli_project_checkpoint_resume(tmp_path):
+    """project --checkpoint: durable memmap output, resumable, and a
+    completed run is never silently overwritten (in-process for speed)."""
+    import os
+
+    from randomprojection_tpu import cli
+    from randomprojection_tpu.streaming import StreamCursor
+
+    X = np.random.default_rng(0).normal(size=(300, 128)).astype(np.float32)
+    xin = str(tmp_path / "x.npy")
+    yout = str(tmp_path / "y.npy")
+    ckpt = str(tmp_path / "cursor.json")
+    np.save(xin, X)
+    argv = [
+        "project", "--input", xin, "--output", yout,
+        "--kind", "gaussian", "--n-components", "16",
+        "--backend", "numpy", "--batch-rows", "100", "--seed", "5",
+        "--checkpoint", ckpt,
+    ]
+    cli.main(argv)
+    ref = np.asarray(
+        GaussianRandomProjection(16, random_state=5, backend="numpy")
+        .fit(X).transform(X)
+    )
+    np.testing.assert_allclose(np.load(yout), ref, rtol=1e-6)
+    assert StreamCursor.load(ckpt).rows_done == 300
+
+    # rerun after completion: refuse, and leave the output untouched
+    with pytest.raises(SystemExit, match="completed"):
+        cli.main(argv)
+    np.testing.assert_allclose(np.load(yout), ref, rtol=1e-6)
+
+    # mid-run resume: corrupt the un-committed tail, rewind the cursor —
+    # the rerun must fill exactly the remaining rows
+    out = np.lib.format.open_memmap(yout, mode="r+")
+    out[100:] = -1.0
+    out.flush()
+    del out
+    StreamCursor(rows_done=100).save(ckpt)
+    cli.main(argv)
+    np.testing.assert_allclose(np.load(yout), ref, rtol=1e-6)
+
+    # resuming with different parameters must refuse (would silently mix
+    # two projections in one output file)
+    StreamCursor(rows_done=100).save(ckpt)
+    argv_other_seed = [a if a != "5" else "6" for a in argv]
+    with pytest.raises(SystemExit, match="different parameters"):
+        cli.main(argv_other_seed)
+
+    # a partial cursor whose output file vanished cannot resume
+    StreamCursor(rows_done=100).save(ckpt)
+    os.remove(yout)
+    with pytest.raises(SystemExit, match="does not exist"):
+        cli.main(argv)
+
+
 def test_cli_project_roundtrip(tmp_path):
     X = np.random.default_rng(0).normal(size=(300, 128)).astype(np.float32)
     xin = str(tmp_path / "x.npy")

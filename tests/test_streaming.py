@@ -106,6 +106,39 @@ def test_fault_injection_resume_bit_identical(X, backend, tmp_path):
     np.testing.assert_array_equal(Y_resumed, Y_ref)
 
 
+def test_consumer_crash_does_not_commit_inflight_batch(X, tmp_path):
+    """The canonical usage writes output AFTER the yield; a crash inside the
+    consumer's write must leave the in-flight batch uncommitted so resume
+    re-yields it (the cursor may never claim rows the consumer didn't see
+    through)."""
+    ckpt = str(tmp_path / "cursor.json")
+    est = make_est().fit(X)
+    Y_ref = np.concatenate(
+        [y for _, y in est.transform_stream(ArraySource(X, 128))]
+    )
+
+    class ConsumerCrash(RuntimeError):
+        pass
+
+    written = {}
+    with pytest.raises(ConsumerCrash):
+        for lo, y in est.transform_stream(
+            ArraySource(X, 128), checkpoint_path=ckpt
+        ):
+            if lo == 256:
+                raise ConsumerCrash("crash before persisting this batch")
+            written[lo] = y  # the durable write
+    assert StreamCursor.load(ckpt).rows_done == 256, (
+        "batch [256, 384) was yielded but never persisted by the consumer; "
+        "it must not be committed"
+    )
+
+    for lo, y in est.transform_stream(ArraySource(X, 128), checkpoint_path=ckpt):
+        written[lo] = y
+    Y = np.concatenate([written[lo] for lo in sorted(written)])
+    np.testing.assert_array_equal(Y, Y_ref)
+
+
 def test_stream_sparse_input_sparse_output():
     rng = np.random.default_rng(0)
     X = rng.normal(size=(400, 96))
