@@ -116,16 +116,6 @@ class JaxBackend(ProjectionBackend):
             raise ValueError(
                 f"materialization must be 'dense' or 'lazy', got {materialization!r}"
             )
-        if materialization == "lazy" and mesh is not None:
-            raise NotImplementedError(
-                "materialization='lazy' is single-device for now; use the "
-                "dense path under a mesh"
-            )
-        if precision == "split2" and feature_axis is not None:
-            raise NotImplementedError(
-                "precision='split2' does not yet compose with feature-axis "
-                "TP; use precision='high' (or DP-only split2)"
-            )
         self.materialization = materialization
         self._transform_fn = None
         self._inverse_fn = None
@@ -133,6 +123,7 @@ class JaxBackend(ProjectionBackend):
         self._pack_fn = None
         self._split_fn = None
         self._slice_fns = {}
+        self._lazy_mesh_fns = {}
 
     def _einsum_precision(self) -> str:
         """Precision for plain einsums ('split2' applies only to the mask
@@ -179,6 +170,25 @@ class JaxBackend(ProjectionBackend):
                     "materialization='lazy' regenerates the mask in-kernel and "
                     f"supports kind='sparse'/'rademacher' only, got {spec.kind!r}"
                 )
+            if spec.n_components % 8:
+                # fail at fit, like the dense path's materialization would
+                raise ValueError(
+                    "materialization='lazy' needs n_components to be a "
+                    f"multiple of 8 (f32 sublane tiling), got {spec.n_components}"
+                )
+            if self.mesh is not None and self.feature_axis is not None:
+                from randomprojection_tpu.ops.pallas_kernels import BLOCK_D
+
+                fshards = self.mesh.shape[self.feature_axis]
+                if spec.n_features % (fshards * BLOCK_D):
+                    # each TP shard regenerates its own BLOCK_D-aligned
+                    # column blocks; a ragged shard would pad mid-matrix and
+                    # silently redefine the block streams vs unsharded
+                    raise ValueError(
+                        "materialization='lazy' under feature-axis TP needs "
+                        f"n_features divisible by feature_shards*BLOCK_D = "
+                        f"{fshards}*{BLOCK_D}, got {spec.n_features}"
+                    )
             if jax.default_backend() in ("cpu", "gpu", "cuda", "rocm"):
                 # the mask is defined by the TPU hardware PRNG (pltpu.prng_*):
                 # no CPU/GPU emulation — the interpreter returns zero bits,
@@ -187,12 +197,6 @@ class JaxBackend(ProjectionBackend):
                     "materialization='lazy' requires a TPU backend (the "
                     "in-kernel PRNG has no CPU/GPU emulation); use the default "
                     "dense materialization"
-                )
-            if spec.n_components % 8:
-                # fail at fit, like the dense path's materialization would
-                raise ValueError(
-                    "materialization='lazy' needs n_components to be a "
-                    f"multiple of 8 (f32 sublane tiling), got {spec.n_components}"
                 )
             return _LazyMask(spec.seed, spec.density if spec.kind == "sparse" else 1.0)
 
@@ -207,16 +211,36 @@ class JaxBackend(ProjectionBackend):
 
             key = _matrix_key(jax, spec.seed)
             density = float(spec.density) if spec.kind == "sparse" else 1.0
-            R = kernels.sparse_matrix(
-                key, spec.n_components, spec.n_features, density, jnp.float32
-            )
             scale = 1.0 / math.sqrt(density * spec.n_components)
+
             # R entries are exactly ±scale (or 0) in f32, so dividing by the
             # same f32 scale yields exact ±1/0 (IEEE division: a/a == 1)
-            mask = (R / jnp.float32(scale)).astype(jnp.bfloat16)
-            sharding = self._replicated_sharding()
-            if sharding is not None:
-                mask = jax.device_put(mask, sharding)
+            def mask_fn(key_, kc, nf, _dt):
+                R = kernels.sparse_matrix(key_, kc, nf, density, jnp.float32)
+                return (R / jnp.float32(scale)).astype(jnp.bfloat16)
+
+            if self.mesh is not None:
+                # generate directly INTO the mesh layout: under feature-axis
+                # TP each chip derives only its own bf16 column shard — no
+                # full (k, d) f32 intermediate on any one device (same
+                # invariant as the dense mesh path)
+                from randomprojection_tpu.parallel.sharded import (
+                    materialize_sharded,
+                )
+
+                mask = materialize_sharded(
+                    mask_fn,
+                    key,
+                    spec.n_components,
+                    spec.n_features,
+                    self.mesh,
+                    feature_axis=self.feature_axis,
+                    dtype=jnp.bfloat16,
+                )
+            else:
+                mask = mask_fn(
+                    key, spec.n_components, spec.n_features, jnp.bfloat16
+                )
             return _SplitMask(mask, scale)
 
         key = _matrix_key(jax, spec.seed)
@@ -336,14 +360,92 @@ class JaxBackend(ProjectionBackend):
         if self._split_fn is None:
             import jax
 
-            from randomprojection_tpu.ops.split_matmul import split2_project
+            if self.feature_axis is not None:
+                # split2 × TP: per-shard hi/lo partial einsums, one psum —
+                # the same collective budget as the dense TP path
+                from randomprojection_tpu.parallel.sharded import (
+                    make_sharded_split2_projector,
+                )
 
-            @jax.jit
-            def _project_split(x, mask, scale):
-                return split2_project(x, mask, scale).astype(x.dtype)
+                self._split_fn = make_sharded_split2_projector(
+                    self.mesh,
+                    data_axis=self.data_axis,
+                    feature_axis=self.feature_axis,
+                )
+            else:
+                from randomprojection_tpu.ops.split_matmul import split2_project
 
-            self._split_fn = _project_split
+                @jax.jit
+                def _project_split(x, mask, scale):
+                    return split2_project(x, mask, scale).astype(x.dtype)
+
+                self._split_fn = _project_split
         return self._split_fn
+
+    def _get_lazy_mesh_fn(self, state, spec: ProjectionSpec):
+        """shard_map'd fused lazy projection over the mesh.
+
+        DP: each device runs the fused kernel on its row shard — the matrix
+        definition is row-tile-independent, so every shard regenerates the
+        same (full) mask stream; zero collectives.  DP×TP: each device
+        passes its BLOCK_D-aligned column-block offset into the kernel seed
+        (``fused_sparse_project(block_offset=...)``) so it contracts against
+        exactly its own blocks of the global matrix, then one psum over the
+        feature axis completes the contraction — same collective budget as
+        the dense TP path, still no R in HBM anywhere.
+        """
+        cache_key = (state.seed, state.density, spec.n_components)
+        fn = self._lazy_mesh_fns.get(cache_key)
+        if fn is not None:
+            return fn
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from randomprojection_tpu.ops.pallas_kernels import (
+            BLOCK_D,
+            BLOCK_N,
+            fused_sparse_project,
+        )
+
+        seed, density, k = state.seed, state.density, spec.n_components
+        data_axis, feature_axis = self.data_axis, self.feature_axis
+
+        if feature_axis is None:
+            in_specs = (P(data_axis, None),)
+
+            def local(x):
+                return fused_sparse_project(
+                    x, seed, k, density,
+                    block_n=min(BLOCK_N, max(8, x.shape[0])),
+                )
+
+        else:
+            in_specs = (P(data_axis, feature_axis),)
+
+            def local(x):
+                offset = jax.lax.axis_index(feature_axis) * (
+                    x.shape[1] // BLOCK_D
+                )
+                partial = fused_sparse_project(
+                    x, seed, k, density,
+                    block_n=min(BLOCK_N, max(8, x.shape[0])),
+                    block_offset=offset,
+                )
+                return jax.lax.psum(partial, feature_axis)
+
+        fn = jax.jit(
+            jax.shard_map(
+                local, mesh=self.mesh, in_specs=in_specs,
+                out_specs=P(data_axis, None),
+                # pallas_call's out_shape carries no varying-mesh-axis info,
+                # so shard_map's vma checker can't see through it; the
+                # collective structure here is explicit (one psum) and
+                # covered by tests
+                check_vma=False,
+            )
+        )
+        self._lazy_mesh_fns[cache_key] = fn
+        return fn
 
     def _slice_rows(self, y, n: int):
         """Drop pad rows.  On a mesh, eager slicing of a sharded array can
@@ -370,21 +472,26 @@ class JaxBackend(ProjectionBackend):
                 x.astype(self._jax.numpy.float32), state.mask, state.scale
             ).astype(x.dtype)
         elif isinstance(state, _LazyMask):
-            from randomprojection_tpu.ops.pallas_kernels import (
-                fused_sparse_project,
-            )
+            if self.mesh is not None:
+                y = self._get_lazy_mesh_fn(state, spec)(
+                    x.astype(self._jax.numpy.float32)
+                ).astype(x.dtype)
+            else:
+                from randomprojection_tpu.ops.pallas_kernels import (
+                    BLOCK_N,
+                    fused_sparse_project,
+                )
 
-            from randomprojection_tpu.ops.pallas_kernels import BLOCK_N
-
-            y = fused_sparse_project(
-                x.astype(self._jax.numpy.float32),
-                state.seed,
-                spec.n_components,
-                state.density,
-                # x is already row-bucketed (power of two ≥ 8): matching the
-                # kernel row tile avoids re-padding small batches to BLOCK_N
-                block_n=min(BLOCK_N, x.shape[0]),
-            ).astype(x.dtype)
+                y = fused_sparse_project(
+                    x.astype(self._jax.numpy.float32),
+                    state.seed,
+                    spec.n_components,
+                    state.density,
+                    # x is already row-bucketed (power of two ≥ 8): matching
+                    # the kernel row tile avoids re-padding small batches to
+                    # BLOCK_N
+                    block_n=min(BLOCK_N, x.shape[0]),
+                ).astype(x.dtype)
         else:
             y = self._get_transform_fn()(x, state)
         return self._slice_rows(y, n), device_resident

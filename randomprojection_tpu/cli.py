@@ -141,7 +141,7 @@ def cmd_project(args):
         ArraySource,
         StreamCursor,
         stream_to_array,
-        stream_transform,
+        stream_to_memmap,
     )
     from randomprojection_tpu.utils.observability import (
         StreamStats,
@@ -189,7 +189,13 @@ def cmd_project(args):
         if os.path.exists(args.checkpoint)
         else 0
     )
-    if rows_done > 0 and os.path.exists(meta_path):
+    if rows_done > 0 and not os.path.exists(meta_path):
+        raise SystemExit(
+            f"checkpoint {args.checkpoint} has partial progress but no "
+            f"{meta_path} fingerprint; cannot prove the resume parameters "
+            f"match the original run — delete the checkpoint to restart"
+        )
+    if rows_done > 0:
         with open(meta_path) as f:
             recorded = json.load(f)
         if recorded != fingerprint:
@@ -210,42 +216,17 @@ def cmd_project(args):
             f"(rows_done={rows_done}); refusing to overwrite {out_path} — "
             f"delete the checkpoint file to re-project from scratch"
         )
-    out = None
-    if rows_done > 0:
-        if not os.path.exists(out_path):
-            raise SystemExit(
-                f"checkpoint {args.checkpoint} records partial progress "
-                f"(rows_done={rows_done}) but {out_path} does not exist; "
-                f"delete the checkpoint to restart"
-            )
-        out = np.lib.format.open_memmap(out_path, mode="r+")
-        if out.shape[0] != source.n_rows:
-            raise SystemExit(
-                f"{out_path} has {out.shape[0]} rows but the input has "
-                f"{source.n_rows}; it belongs to a different run"
-            )
-    else:
+    if rows_done == 0:
         with open(meta_path, "w") as f:
             json.dump(fingerprint, f)
-    with profile_trace(args.profile_dir):
-        for lo, y in stream_transform(
-            est, source, checkpoint_path=args.checkpoint, stats=stats
-        ):
-            if sp.issparse(y):
-                y = y.toarray()
-            if out is None:
-                out = np.lib.format.open_memmap(
-                    out_path, mode="w+", dtype=y.dtype,
-                    shape=(source.n_rows, y.shape[1]),
-                )
-            out[lo : lo + y.shape[0]] = y
-            out.flush()  # durable before the cursor commits this batch
-    if out is None:  # 0-row input: nothing streamed, emit the empty file
-        out = np.lib.format.open_memmap(
-            out_path, mode="w+",
-            dtype=est._stream_out_dtype() or np.float64,
-            shape=(source.n_rows, est._stream_out_width()),
-        )
+    try:
+        with profile_trace(args.profile_dir):
+            out = stream_to_memmap(
+                est, source, out_path,
+                checkpoint_path=args.checkpoint, stats=stats,
+            )
+    except ValueError as e:
+        raise SystemExit(str(e))
     print(json.dumps({"output": out_path, "shape": list(out.shape),
                       "dtype": str(out.dtype), **stats.summary()}))
 

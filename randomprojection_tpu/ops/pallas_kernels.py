@@ -84,7 +84,11 @@ def _mask_block(density):
 
 def _project_kernel(seed_ref, x_ref, o_ref, *, k, density, scale, n_blocks_d):
     j = pl.program_id(1)
-    pltpu.prng_seed(seed_ref[0], j)  # (seed, block) → bits: row-tile-free
+    # (seed, global block) → bits: row-tile-free.  seed_ref[1] is the
+    # column-block offset of this shard under feature-axis TP (0 unsharded),
+    # so a shard holding X[:, lo:hi] regenerates exactly the mask blocks of
+    # its own column range — the same global matrix, distributed.
+    pltpu.prng_seed(seed_ref[0], j + seed_ref[1])
     r = _mask_block(density)((k, x_ref.shape[1]))
 
     @pl.when(j == 0)
@@ -120,6 +124,7 @@ def fused_sparse_project(
     density: float,
     *,
     block_n: int = BLOCK_N,
+    block_offset=0,
     interpret: bool = False,
 ):
     """``Y = X @ R(seed)ᵀ`` with ``R`` regenerated in-kernel, never in HBM.
@@ -129,6 +134,13 @@ def fused_sparse_project(
     sublane tiling).  Ragged ``n``/``d`` are zero-padded (zero rows/cols
     contribute nothing; the mask block for padded ``d`` is generated but
     multiplied by zeros).
+
+    ``block_offset`` (int or traced int32 scalar) shifts the column-block
+    indices: a feature-axis TP shard holding ``X[:, lo:hi]`` (``lo``
+    BLOCK_D-aligned) passes ``lo // BLOCK_D`` and computes its partial
+    product against exactly its own blocks of the global matrix.  The
+    per-call scale is linear, so ``psum`` of the scaled partials equals the
+    unsharded result.
     """
     density = check_density(density, x.shape[1])
     check_input_size(n_components, x.shape[1])
@@ -150,7 +162,9 @@ def fused_sparse_project(
     ni = x.shape[0] // block_n
     nj = x.shape[1] // BLOCK_D
 
-    seed_arr = jnp.asarray([seed], dtype=jnp.int32)
+    seed_arr = jnp.stack(
+        [jnp.int32(seed), jnp.asarray(block_offset, dtype=jnp.int32)]
+    )
     y = pl.pallas_call(
         functools.partial(
             _project_kernel, k=k, density=density, scale=scale, n_blocks_d=nj

@@ -35,6 +35,7 @@ __all__ = [
     "feature_sharded",
     "materialize_sharded",
     "make_sharded_projector",
+    "make_sharded_split2_projector",
 ]
 
 
@@ -118,6 +119,44 @@ def make_sharded_projector(
             # one ICI all-reduce completes the contraction over sharded d
             y = jax.lax.psum(partial, feature_axis)
             return y.astype(x.dtype)
+
+    sharded = jax.shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return jax.jit(sharded)
+
+
+def make_sharded_split2_projector(
+    mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    feature_axis: str = FEATURE_AXIS,
+):
+    """Split-precision (split2) transform under DP×TP.
+
+    The contraction distributes over the feature shards exactly as in
+    ``make_sharded_projector``: each chip splits its own ``X[:, d_shard]``
+    into hi/lo bf16 halves, runs two partial mask einsums, and ONE ``psum``
+    over ``feature_axis`` completes both halves at once (the two partial
+    sums are added before the collective, so TP costs no extra
+    communication vs the dense path).  The common ``·scale`` is applied
+    after the psum.  Expects X laid out ``P(data, feature)``, the unscaled
+    ±1/0 bf16 mask ``P(None, feature)``; returns Y ``P(data, None)`` in
+    f32-grade accuracy (see ``ops/split_matmul.py``).
+    """
+    from randomprojection_tpu.ops.split_matmul import split_f32_to_bf16_pair
+
+    in_specs = (P(data_axis, feature_axis), P(None, feature_axis), P())
+    out_specs = P(data_axis, None)
+
+    def local(x, mask, scale):
+        x_hi, x_lo = split_f32_to_bf16_pair(x.astype(jnp.float32))
+        a = jnp.einsum(
+            "nd,kd->nk", x_hi, mask, preferred_element_type=jnp.float32
+        )
+        b = jnp.einsum(
+            "nd,kd->nk", x_lo, mask, preferred_element_type=jnp.float32
+        )
+        y = jax.lax.psum(a + b, feature_axis)
+        return (y * scale).astype(x.dtype)
 
     sharded = jax.shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     return jax.jit(sharded)

@@ -37,6 +37,7 @@ __all__ = [
     "StreamCursor",
     "stream_transform",
     "stream_to_array",
+    "stream_to_memmap",
 ]
 
 
@@ -260,6 +261,73 @@ def stream_transform(
             yield from emit(pending.pop(0))
     while pending:
         yield from emit(pending.pop(0))
+
+
+def stream_to_memmap(
+    estimator,
+    source: RowBatchSource,
+    out_path: str,
+    *,
+    checkpoint_path: str,
+    stats=None,
+    pipeline_depth: int = 2,
+) -> np.ndarray:
+    """Stream into a durable on-disk ``.npy`` memmap, resumable mid-run.
+
+    The durability contract: each batch is written to ``out_path`` and
+    **flushed before** the stream cursor commits it (the cursor advances
+    only when the next batch is requested — see ``stream_transform``), so a
+    crash at any point — transform, write, or cursor save — resumes from
+    the checkpoint without losing or duplicating rows.
+
+    A fresh run creates the memmap from the first batch's dtype/width; a
+    resume (``checkpoint_path`` has ``0 < rows_done < n_rows``) requires
+    the memmap from the original run at ``out_path`` (a fresh buffer would
+    leave the already-committed rows uninitialized) and the caller is
+    responsible for verifying the estimator parameters match that run (see
+    ``cli.cmd_project`` for a fingerprint-sidecar example).  Re-running a
+    completed checkpoint is a no-op returning the existing memmap.
+    Sparse output batches are densified into the memmap.
+    """
+    if not out_path.endswith(".npy"):
+        raise ValueError(f"out_path must end in .npy, got {out_path!r}")
+    rows_done = 0
+    if os.path.exists(checkpoint_path):
+        rows_done = StreamCursor.load(checkpoint_path).rows_done
+    out = None
+    if rows_done > 0:
+        if not os.path.exists(out_path):
+            raise ValueError(
+                f"checkpoint {checkpoint_path} records progress "
+                f"(rows_done={rows_done}) but {out_path} does not exist; "
+                f"delete the checkpoint to restart"
+            )
+        out = np.lib.format.open_memmap(out_path, mode="r+")
+        if out.shape[0] != source.n_rows:
+            raise ValueError(
+                f"{out_path} has {out.shape[0]} rows but the source has "
+                f"{source.n_rows}; it belongs to a different run"
+            )
+    for lo, y in stream_transform(
+        estimator, source, checkpoint_path=checkpoint_path,
+        stats=stats, pipeline_depth=pipeline_depth,
+    ):
+        if sp.issparse(y):
+            y = y.toarray()
+        if out is None:
+            out = np.lib.format.open_memmap(
+                out_path, mode="w+", dtype=y.dtype,
+                shape=(source.n_rows, y.shape[1]),
+            )
+        out[lo : lo + y.shape[0]] = y
+        out.flush()  # durable before the cursor commits this batch
+    if out is None:  # 0-row source: nothing streamed, emit the empty file
+        out = np.lib.format.open_memmap(
+            out_path, mode="w+",
+            dtype=estimator._stream_out_dtype() or np.float64,
+            shape=(source.n_rows, estimator._stream_out_width()),
+        )
+    return out
 
 
 def stream_to_array(estimator, source, out=None, **kwargs) -> np.ndarray:

@@ -177,6 +177,116 @@ def test_lazy_backend_end_to_end():
     )
 
 
+def _require_8_devices():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh (default suite)")
+
+
+def test_lazy_tp_shard_map_abstract_eval():
+    """DP×TP lazy path structure on the 8-device CPU mesh: the shard_map'd
+    kernel with per-shard block offsets must trace and produce the right
+    shapes (abstract eval only; values need the real chip)."""
+    _require_8_devices()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from randomprojection_tpu.ops.pallas_kernels import (
+        BLOCK_D,
+        fused_sparse_project,
+    )
+    from randomprojection_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 4, "feature": 2})
+    k = 16
+
+    def local(x):
+        offset = jax.lax.axis_index("feature") * (x.shape[1] // BLOCK_D)
+        p = fused_sparse_project(x, 0, k, 0.5, block_offset=offset)
+        return jax.lax.psum(p, "feature")
+
+    fn = jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=(P("data", "feature"),),
+            out_specs=P("data", None), check_vma=False,
+        )
+    )
+    out = jax.eval_shape(
+        fn, jax.ShapeDtypeStruct((64, 4 * BLOCK_D), jnp.float32)
+    )
+    assert out.shape == (64, k) and out.dtype == jnp.float32
+
+
+def test_lazy_tp_alignment_validated_at_fit():
+    """Ragged per-shard column blocks would redefine the matrix; the fit
+    must refuse before any kernel runs (checked on any platform)."""
+    _require_8_devices()
+    from randomprojection_tpu import SparseRandomProjection
+    from randomprojection_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 4, "feature": 2})
+    X = np.zeros((16, 700), dtype=np.float32)  # 700 % (2*512) != 0
+    with pytest.raises(ValueError, match="feature_shards"):
+        SparseRandomProjection(
+            8, random_state=0, density=0.5, backend="jax",
+            backend_options={
+                "mesh": mesh, "feature_axis": "feature",
+                "materialization": "lazy",
+            },
+        ).fit(X)
+
+
+@requires_tpu
+def test_lazy_dp_mesh_matches_single_device(x):
+    """Lazy under a DP mesh must reproduce the no-mesh lazy result exactly
+    (the matrix definition is row-tile- and shard-independent)."""
+    from randomprojection_tpu import SparseRandomProjection
+    from randomprojection_tpu.parallel import default_mesh
+
+    mesh = default_mesh()  # all real chips on 'data'
+    common = dict(
+        n_components=32, density=1 / 3, random_state=5, backend="jax",
+    )
+    est_m = SparseRandomProjection(
+        **common, backend_options={"mesh": mesh, "materialization": "lazy"}
+    ).fit(x)
+    est_1 = SparseRandomProjection(
+        **common, backend_options={"materialization": "lazy"}
+    ).fit(x)
+    np.testing.assert_array_equal(
+        np.asarray(est_m.transform(x)), np.asarray(est_1.transform(x))
+    )
+
+
+@requires_tpu
+def test_lazy_tp_mesh_single_shard_matches():
+    """The TP lazy code path (offset fold-in + psum) on however many real
+    chips exist; with one feature shard the offset is zero and the result
+    must equal the unsharded kernel bit-for-bit."""
+    from randomprojection_tpu import SparseRandomProjection
+    from randomprojection_tpu.parallel import make_mesh
+
+    import jax
+
+    X = np.random.default_rng(2).normal(size=(64, 1024)).astype(np.float32)
+    mesh = make_mesh({"data": len(jax.devices()), "feature": 1})
+    common = dict(n_components=16, density=0.25, random_state=3, backend="jax")
+    est_tp = SparseRandomProjection(
+        **common,
+        backend_options={
+            "mesh": mesh, "feature_axis": "feature", "materialization": "lazy",
+        },
+    ).fit(X)
+    est_1 = SparseRandomProjection(
+        **common, backend_options={"materialization": "lazy"}
+    ).fit(X)
+    np.testing.assert_array_equal(
+        np.asarray(est_tp.transform(X)), np.asarray(est_1.transform(X))
+    )
+
+
 def test_lazy_rejects_gaussian_kind():
     from randomprojection_tpu import GaussianRandomProjection
 

@@ -105,6 +105,59 @@ def test_estimator_with_tp_mesh_backend(devices):
         )
 
 
+def test_split2_composes_with_tp_mesh(devices):
+    """precision='split2' under {'data':4,'feature':2}: per-shard hi/lo
+    partial einsums + one psum must match the single-device split2 result
+    exactly (same arithmetic, distributed over d) and the f64 reference at
+    split2's documented f32-grade tolerance."""
+    from randomprojection_tpu import SparseRandomProjection
+
+    mesh = make_mesh({"data": 4, "feature": 2})
+    X = np.random.default_rng(9).normal(size=(512, 2048)).astype(np.float32)
+    est_tp = SparseRandomProjection(
+        n_components=32, random_state=2, density=1 / 3, backend="jax",
+        backend_options={
+            "mesh": mesh, "feature_axis": "feature", "precision": "split2",
+        },
+    ).fit(X)
+    Y_tp = np.asarray(est_tp.transform(X))
+
+    est_1 = SparseRandomProjection(
+        n_components=32, random_state=2, density=1 / 3, backend="jax",
+        backend_options={"precision": "split2"},
+    ).fit(X)
+    Y_1 = np.asarray(est_1.transform(X))
+
+    # same mask (counter PRNG), same two-pass arithmetic → tight agreement
+    np.testing.assert_allclose(Y_tp, Y_1, rtol=1e-6, atol=1e-6)
+    # and f32-grade accuracy vs the exact f64 projection
+    R = est_1.components_as_numpy().astype(np.float64)
+    np.testing.assert_allclose(
+        Y_tp, X.astype(np.float64) @ R.T, rtol=1e-3, atol=1e-3
+    )
+
+
+def test_split2_composes_with_dp_only_mesh(devices):
+    """split2 under a pure-DP mesh (no feature axis): replicated mask,
+    row-sharded X, no collectives."""
+    from randomprojection_tpu import SparseRandomProjection
+
+    mesh = default_mesh()
+    X = np.random.default_rng(11).normal(size=(256, 1024)).astype(np.float32)
+    est = SparseRandomProjection(
+        n_components=16, random_state=4, density=0.1, backend="jax",
+        backend_options={"mesh": mesh, "precision": "split2"},
+    ).fit(X)
+    est_1 = SparseRandomProjection(
+        n_components=16, random_state=4, density=0.1, backend="jax",
+        backend_options={"precision": "split2"},
+    ).fit(X)
+    np.testing.assert_allclose(
+        np.asarray(est.transform(X)), np.asarray(est_1.transform(X)),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
 def test_estimator_with_mesh_backend(devices):
     """End-to-end: estimator on a jax backend bound to an 8-device mesh."""
     from randomprojection_tpu import GaussianRandomProjection

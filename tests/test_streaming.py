@@ -1,6 +1,8 @@
 """Streaming-layer tests: batching, checkpoint/resume, fault injection
 (SURVEY.md §6 failure detection / §8 step 5)."""
 
+import os
+
 import numpy as np
 import pytest
 import scipy.sparse as sp
@@ -137,6 +139,40 @@ def test_consumer_crash_does_not_commit_inflight_batch(X, tmp_path):
         written[lo] = y
     Y = np.concatenate([written[lo] for lo in sorted(written)])
     np.testing.assert_array_equal(Y, Y_ref)
+
+
+def test_stream_to_memmap_crash_resume(X, tmp_path):
+    """Library-level durable memmap streaming: crash mid-run (injected
+    fault), resume into the same file, result identical to one-shot."""
+    from randomprojection_tpu.streaming import stream_to_memmap
+
+    est = make_est().fit(X)
+    Y_ref = np.asarray(est.transform(X))
+    out_path = str(tmp_path / "y.npy")
+    ckpt = str(tmp_path / "c.json")
+
+    src = FaultInjectionSource(ArraySource(X, 128), fail_after_batches=3)
+    with pytest.raises(FaultInjectionSource.InjectedFault):
+        stream_to_memmap(est, src, out_path, checkpoint_path=ckpt)
+    committed = StreamCursor.load(ckpt).rows_done
+    assert 0 < committed < 1000
+    # committed rows are durable on disk already
+    partial = np.lib.format.open_memmap(out_path, mode="r")
+    np.testing.assert_array_equal(partial[:committed], Y_ref[:committed])
+    del partial
+
+    src.disarm()
+    out = stream_to_memmap(est, src, out_path, checkpoint_path=ckpt)
+    np.testing.assert_array_equal(np.asarray(out), Y_ref)
+    # completed rerun: no-op, same contents
+    out2 = stream_to_memmap(est, src, out_path, checkpoint_path=ckpt)
+    np.testing.assert_array_equal(np.asarray(out2), Y_ref)
+
+    # a resume whose memmap vanished is refused
+    StreamCursor(rows_done=128).save(ckpt)
+    os.remove(out_path)
+    with pytest.raises(ValueError, match="does not exist"):
+        stream_to_memmap(est, src, out_path, checkpoint_path=ckpt)
 
 
 def test_stream_sparse_input_sparse_output():
