@@ -11,16 +11,23 @@ Method
 ------
 - Achlioptas s=3 (density 1/3) projection matrix — the exact 1M×4096→256
   workload of BASELINE.json config 2 — in dense device layout.
-- Two MXU modes are measured, and the headline is the FASTEST mode whose
-  measured pairwise-distance distortion vs the CPU f64 reference (same R)
-  meets the ≤1e-3 budget of BASELINE.json:5:
-    * ``bf16``: bf16 inputs, f32 accumulation (1 MXU pass, ~1.6e-3 typical)
+- Three MXU modes are measured; the headline is the FASTEST mode that both
+  meets the ≤1e-3 pairwise-distance budget of BASELINE.json:5 (vs the CPU
+  f64 reference, same R) and has a believable timing:
+    * ``bf16``: bf16 inputs, f32 accumulation (1 MXU pass, ~1.6e-3+)
+    * ``bf16_split2``: X split hi/lo bf16 vs exact ±1 mask (2 passes, ~4e-6)
     * ``f32_high``: f32 inputs, 3-pass bf16 ("high" precision, ~2e-5)
 - Iterations are dependency-chained through the input (x += tiny·y) inside
-  one ``lax.scan``, and a checksum is returned, so neither DCE nor
-  identical-call caching can fake the timing (SURVEY.md §7 measurement
-  notes on this virtualized platform).  ``timing_suspect`` is set when the
-  implied FLOP rate exceeds 2× the v5e peak — on real hardware it is false.
+  one ``lax.scan``, every timed call sees distinct argument values (call
+  index folded in on device), calls are serialized through a scalar carry,
+  and a checksum is returned — so neither DCE nor call caching can fake the
+  timing undetected (SURVEY.md §7 notes on this virtualized platform).
+  Each mode carries ``implied_tflops`` (nominal 2·d·k per row),
+  ``executed_tflops`` (× MXU passes actually run), and ``timing_suspect``
+  (executed rate > 2× v5e peak); a suspect mode never beats a believable
+  one for the headline (if every mode is suspect, the most accurate is
+  reported with its flag set, marking the whole run untrustworthy).  On
+  real hardware no mode trips the flag.
 - ``vs_baseline`` = TPU rows/s ÷ CPU-reference rows/s, where the CPU
   reference is dense f32 BLAS on this host measured in the same run (the
   honest CPU number per SURVEY.md §7 — the reference's own sparse CSR path

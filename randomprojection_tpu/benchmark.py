@@ -59,29 +59,48 @@ def _mode_project_fn(jax, jnp, name, scale):
 
 
 def measure_mode(jax, jnp, R_f32, name, scale, batch, steps, calls, d):
-    """Time the chained-scan projection loop in one MXU mode."""
+    """Time the chained-scan projection loop in one MXU mode.
+
+    Anti-caching defenses, per SURVEY.md §7 (this environment's virtualized
+    TPU has been observed serving repeated calls from a cache):
+
+    - every timed call sees DISTINCT argument values: the call index is
+      folded into the input on device (one buffer, no extra HBM);
+    - a scalar carry from call ``i``'s checksum is folded into call
+      ``i+1``'s input, serializing the calls;
+    - within a call, scan steps chain through the input (defeats DCE).
+
+    The caller cross-checks the resulting rate against the hardware peak
+    per mode (``implied_tflops`` / ``timing_suspect``).
+    """
     project, in_dtype, r_prep = _mode_project_fn(jax, jnp, name, scale)
     r = r_prep(R_f32)
     x0 = jax.random.normal(jax.random.key(1), (batch, d), dtype=in_dtype)
 
     @jax.jit
-    def run_steps(x, r):
+    def run_steps(x, r, carry, call_idx):
+        # fold the call index and the previous call's result into this
+        # call's input: calls can neither be cached (distinct values per
+        # call) nor reordered (serialized on carry)
+        x = x + (carry * 1e-24 + call_idx * 1e-6).astype(x.dtype)
+
         def step(x, _):
             y = project(x, r)
-            # chain the next input on this output: defeats DCE and
-            # identical-argument call caching; numerically negligible
             x = x + (y[:, :1] * 1e-24).astype(x.dtype)
             return x, y[0, 0]
 
-        return jax.lax.scan(step, x, None, length=steps)
+        _, ys = jax.lax.scan(step, x, None, length=steps)
+        return ys.sum()
 
-    x, checks = run_steps(x0, r)  # warmup / compile
-    x.block_until_ready()
+    carry = run_steps(x0, r, jnp.float32(0), jnp.float32(-1))  # warmup
+    carry.block_until_ready()
 
+    checks = []
     t0 = time.perf_counter()
-    for _ in range(calls):
-        x, checks = run_steps(x, r)
-    x.block_until_ready()
+    for c in range(calls):
+        carry = run_steps(x0, r, carry, jnp.float32(c))
+        checks.append(carry)
+    carry.block_until_ready()
     elapsed = time.perf_counter() - t0
 
     rows = calls * steps * batch
@@ -89,8 +108,25 @@ def measure_mode(jax, jnp, R_f32, name, scale, batch, steps, calls, d):
         "rows_per_s": rows / elapsed,
         "elapsed_s": elapsed,
         "rows_timed": rows,
-        "checksum": float(checks.sum()),
+        "checksum": float(np.asarray(jnp.stack(checks)).sum()),
     }
+
+
+def select_headline(results: dict, budget: float = DISTORTION_BUDGET) -> str:
+    """Fastest mode that (a) meets the distortion budget and (b) has a
+    believable timing.  A ``timing_suspect`` mode is never preferred over
+    any believable one; in the degenerate case where EVERY mode is suspect
+    the most accurate one is reported — with its flag preserved in the
+    JSON, so the whole run is self-describing as untrustworthy."""
+    eligible = [
+        n for n, r in results.items()
+        if r["distortion"] <= budget and not r["timing_suspect"]
+    ]
+    if not eligible:
+        non_suspect = [n for n, r in results.items() if not r["timing_suspect"]]
+        pool = non_suspect or list(results)
+        eligible = [min(pool, key=lambda n: results[n]["distortion"])]
+    return max(eligible, key=lambda n: results[n]["rows_per_s"])
 
 
 def measure_distortion(jax, jnp, R_f32, x_cpu, name, scale):
@@ -120,16 +156,26 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
     rng = np.random.default_rng(0)
     x_cpu = rng.normal(size=(16384, d)).astype(np.float32)
 
+    # effective MXU FLOPs per row differ per mode: bf16 is 1 pass over the
+    # contraction, split2 runs it twice, 'high' three times — the peak
+    # check must use what the hardware actually executes
+    mxu_passes = {"bf16": 1, "bf16_split2": 2, "f32_high": 3}
+
     results = {}
     for name in ("bf16", "bf16_split2", "f32_high"):
         perf = measure_mode(jax, jnp, R, name, scale, d=d, **cfg)
         perf["distortion"] = measure_distortion(jax, jnp, R, x_cpu, name, scale)
+        # nominal rate (the comparable rows/s·2dk number) and executed rate
+        # (× MXU passes) — the suspect flag keys on the EXECUTED rate
+        nominal = perf["rows_per_s"] * 2 * d * k / 1e12
+        perf["implied_tflops"] = round(nominal, 1)
+        perf["executed_tflops"] = round(nominal * mxu_passes[name], 1)
+        perf["timing_suspect"] = bool(
+            perf["executed_tflops"] > 2 * V5E_PEAK_TFLOPS
+        )
         results[name] = perf
 
-    eligible = [n for n, r in results.items() if r["distortion"] <= DISTORTION_BUDGET]
-    if not eligible:  # nothing meets budget: report the most accurate mode
-        eligible = [min(results, key=lambda n: results[n]["distortion"])]
-    headline = max(eligible, key=lambda n: results[n]["rows_per_s"])
+    headline = select_headline(results)
     head = results[headline]
 
     # CPU reference: dense f32 BLAS on this host, same shapes
@@ -138,8 +184,6 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
     t0 = time.perf_counter()
     x_cpu @ r_cpu.T
     cpu_rows_per_s = x_cpu.shape[0] / (time.perf_counter() - t0)
-
-    implied_tflops = head["rows_per_s"] * 2 * d * k / 1e12
 
     return {
         "metric": f"rows/sec/chip {d}->{k} (Achlioptas s=3, data-resident, {headline})",
@@ -154,12 +198,15 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
                 "rows_per_s": round(r["rows_per_s"], 1),
                 "distortion": r["distortion"],
                 "elapsed_s": round(r["elapsed_s"], 4),
+                "implied_tflops": r["implied_tflops"],
+                "executed_tflops": r["executed_tflops"],
+                "timing_suspect": r["timing_suspect"],
             }
             for n, r in results.items()
         },
         "rows_timed": head["rows_timed"],
-        "implied_tflops": round(implied_tflops, 1),
-        "timing_suspect": bool(implied_tflops > 2 * V5E_PEAK_TFLOPS),
+        "implied_tflops": head["implied_tflops"],
+        "timing_suspect": head["timing_suspect"],
         "checksum": head["checksum"],
     }
 

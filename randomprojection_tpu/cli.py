@@ -153,13 +153,13 @@ def cmd_project(args):
     else:
         X = np.load(args.input, mmap_mode="r")
     source = ArraySource(X, args.batch_rows)
-    est = _make_estimator(args).fit_source(source)
     stats = StreamStats(log_every=10)
     # np.save appends .npy itself; normalize once so the JSON summary and
     # the memmap path always name the file that actually exists
     out_path = args.output if args.output.endswith(".npy") else args.output + ".npy"
 
     if args.checkpoint is None:
+        est = _make_estimator(args).fit_source(source)
         with profile_trace(args.profile_dir):
             Y = stream_to_array(est, source, stats=stats)
         if sp.issparse(Y):
@@ -172,10 +172,15 @@ def cmd_project(args):
     # Checkpointed runs write through an on-disk .npy memmap so every
     # committed batch is durable: a mid-run crash resumes from the cursor
     # into the same file, and a completed run is never silently overwritten.
-    # A fingerprint sidecar pins the run configuration: resuming with
-    # different parameters would silently mix two projections in one file.
+    # A fingerprint sidecar pins the run configuration — input data,
+    # estimator parameters, output path: resuming with anything different
+    # would silently mix two projections in one file.  Built from the raw
+    # CLI args (not the fitted estimator) so every refusal below fires
+    # before any device work or matrix materialization.
     fingerprint = {
-        "kind": args.kind, "n_components": est.n_components_,
+        "input": os.path.abspath(args.input),
+        "kind": args.kind, "n_components": str(args.n_components),
+        "eps": args.eps,
         "seed": args.seed, "density": str(getattr(args, "density", "auto")),
         "backend": args.backend, "batch_rows": args.batch_rows,
         "precision": getattr(args, "precision", None),
@@ -216,6 +221,7 @@ def cmd_project(args):
             f"(rows_done={rows_done}); refusing to overwrite {out_path} — "
             f"delete the checkpoint file to re-project from scratch"
         )
+    est = _make_estimator(args).fit_source(source)
     if rows_done == 0:
         with open(meta_path, "w") as f:
             json.dump(fingerprint, f)

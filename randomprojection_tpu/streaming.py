@@ -233,22 +233,23 @@ def stream_transform(
             y = np.asarray(y)
             if out_dtype is not None:
                 y = y.astype(out_dtype, copy=False)
-        if stats is not None:
-            stats.on_commit(start_row, in_nbytes, y)
-        return start_row, n_rows, y
+        return start_row, n_rows, y, in_nbytes
 
     def emit(entry):
-        # Yield the batch FIRST; advance/save the cursor only after control
-        # returns from the yield — i.e. after the consumer's loop body (the
-        # canonical write-output-after-yield usage) has completed for this
-        # batch.  Saving before the yield would let a crash inside the
-        # consumer silently drop the batch's row range on resume: the cursor
-        # would claim rows the consumer never durably wrote.
-        start_row, n_rows, y = materialize(entry)
+        # Yield the batch FIRST; advance/save the cursor (and count the
+        # commit) only after control returns from the yield — i.e. after
+        # the consumer's loop body (the canonical write-output-after-yield
+        # usage) has completed for this batch.  Committing before the yield
+        # would let a crash inside the consumer silently drop the batch's
+        # row range on resume: the cursor (or the stats log) would claim
+        # rows the consumer never durably wrote.
+        start_row, n_rows, y, in_nbytes = materialize(entry)
         yield start_row, y
         cursor.rows_done = start_row + n_rows
         if checkpoint_path is not None:
             cursor.save(checkpoint_path)
+        if stats is not None:
+            stats.on_commit(start_row, in_nbytes, y)
 
     for start_row, batch in source.iter_batches(cursor.rows_done):
         # _transform_async is each estimator's own (possibly overridden)

@@ -1,0 +1,54 @@
+"""Benchmark harness policy tests (no device needed).
+
+The round-1 review requirement these guard: no number whose implied
+TFLOP/s exceeds 2× peak may be published unflagged, and a flagged mode
+never beats a believable one for the headline (all-suspect runs report
+the most accurate mode with its flag preserved).
+"""
+
+from randomprojection_tpu.benchmark import DISTORTION_BUDGET, select_headline
+
+
+def mode(rows, dist, suspect):
+    return {"rows_per_s": rows, "distortion": dist, "timing_suspect": suspect}
+
+
+def test_fastest_in_budget_wins():
+    results = {
+        "bf16": mode(9e7, 2e-3, False),       # fast but out of budget
+        "bf16_split2": mode(5e7, 4e-6, False),
+        "f32_high": mode(3e7, 2e-5, False),
+    }
+    assert select_headline(results) == "bf16_split2"
+
+
+def test_suspect_mode_never_headlines():
+    results = {
+        "bf16": mode(3e9, 2e-3, True),
+        "bf16_split2": mode(2e9, 4e-6, True),  # in budget but impossible
+        "f32_high": mode(3e7, 2e-5, False),
+    }
+    assert select_headline(results) == "f32_high"
+
+
+def test_all_suspect_falls_back_to_most_accurate():
+    results = {
+        "bf16": mode(3e9, 2e-3, True),
+        "bf16_split2": mode(2e9, 4e-6, True),
+        "f32_high": mode(1e9, 2e-5, True),
+    }
+    # nothing believable: publish the most accurate (its flag stays set in
+    # the JSON, so the reader sees the whole run is suspect)
+    assert select_headline(results) == "bf16_split2"
+
+
+def test_none_in_budget_picks_most_accurate_non_suspect():
+    results = {
+        "bf16": mode(9e7, 3.9e-3, False),
+        "f32_high": mode(3e7, 2e-3, False),
+    }
+    assert select_headline(results) == "f32_high"
+
+
+def test_budget_constant_matches_contract():
+    assert DISTORTION_BUDGET == 1e-3

@@ -226,6 +226,14 @@ def test_cli_project_checkpoint_resume(tmp_path):
     with pytest.raises(SystemExit, match="different parameters"):
         cli.main(argv_other_seed)
 
+    # ...and so must resuming against a different input file, even one
+    # with identical shape
+    xin2 = str(tmp_path / "x2.npy")
+    np.save(xin2, X)
+    argv_other_input = [a if a != xin else xin2 for a in argv]
+    with pytest.raises(SystemExit, match="different parameters"):
+        cli.main(argv_other_input)
+
     # a partial cursor whose output file vanished cannot resume
     StreamCursor(rows_done=100).save(ckpt)
     os.remove(yout)
