@@ -61,6 +61,26 @@ def _resolve_seed(random_state) -> int:
     )
 
 
+def _feature_names_out(est, input_features=None):
+    """Shared ``get_feature_names_out`` body for JL estimators and sketches.
+
+    sklearn ``ClassNamePrefixFeaturesOutMixin`` semantics: validates
+    ``input_features`` length against ``n_features_in_`` when given, and
+    names outputs ``<classname_lowercase><i>`` (object dtype) — output
+    dimensions have no input-feature lineage.
+    """
+    est._check_is_fitted()
+    if input_features is not None and len(input_features) != est.n_features_in_:
+        raise ValueError(
+            "input_features should have length equal to number of features "
+            f"seen during fit ({est.n_features_in_}), got {len(input_features)}"
+        )
+    prefix = type(est).__name__.lower()
+    return np.asarray(
+        [f"{prefix}{i}" for i in range(est.n_components_)], dtype=object
+    )
+
+
 class BaseRandomProjection:
     """Shared estimator machinery; subclasses define the matrix kind.
 
@@ -225,6 +245,16 @@ class BaseRandomProjection:
 
     def _dense_output(self) -> bool:
         return True
+
+    def get_feature_names_out(self, input_features=None):
+        """Output feature names: ``<classname_lowercase><index>``.
+
+        Matches sklearn's ``ClassNamePrefixFeaturesOutMixin`` naming for
+        random projections (``test_random_projection.py:459-481`` asserts
+        exactly these strings); projected dimensions have no input-feature
+        lineage, so ``input_features`` only participates in validation.
+        """
+        return _feature_names_out(self, input_features)
 
     # -- streaming (layer L2) --------------------------------------------------
 

@@ -272,6 +272,13 @@ class CountSketch:
     def _stream_out_width(self) -> int:
         return self.n_components_
 
+    def get_feature_names_out(self, input_features=None):
+        """Output names ``countsketch<i>`` (same naming rule as the JL
+        estimators; sketch buckets have no input-feature lineage)."""
+        from randomprojection_tpu.models.base import _feature_names_out
+
+        return _feature_names_out(self, input_features)
+
     def inverse_transform(self, Y):
         """Unbiased decode: ``x̂[j] = s(j) · Y[:, h(j)]``."""
         self._check_is_fitted()

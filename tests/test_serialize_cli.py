@@ -154,6 +154,28 @@ def test_stream_stats_single_batch_sane():
     assert stats.rows_per_s() < 1e10
 
 
+def test_packaging_entry_point_and_version():
+    """pyproject.toml must declare a resolvable console entry point and a
+    version matching the package (`pip install -e . && randomprojection-tpu
+    info` is the end-to-end check; this guards the wiring in CI)."""
+    import importlib
+    import os
+
+    tomllib = pytest.importorskip("tomllib")  # stdlib from 3.11
+
+    import randomprojection_tpu as rp
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    target = meta["project"]["scripts"]["randomprojection-tpu"]
+    mod, fn = target.split(":")
+    assert callable(getattr(importlib.import_module(mod), fn))
+    assert meta["project"]["version"] == rp.__version__
+    # the C++ source ships with the wheel (built at first use)
+    assert "*.cpp" in str(meta["tool"]["setuptools"]["package-data"])
+
+
 def _run_cli(*argv):
     return subprocess.run(
         [sys.executable, "-m", "randomprojection_tpu", *argv],

@@ -133,6 +133,58 @@ def test_inverse_transform_parity():
     )
 
 
+@pytest.mark.parametrize(
+    "ours_cls, theirs_cls_name",
+    [
+        (GaussianRandomProjection, "GaussianRandomProjection"),
+        (SparseRandomProjection, "SparseRandomProjection"),
+    ],
+)
+def test_get_feature_names_out_matches_sklearn(ours_cls, theirs_cls_name):
+    """Mirror of sklearn test_random_projection.py:459-481: names are
+    ``<classname_lowercase><i>`` for i in range(n_components_), dtype
+    object — byte-identical to sklearn's output."""
+    X = np.random.default_rng(0).normal(size=(40, 96))
+    ours = ours_cls(n_components=7, random_state=0, backend="numpy").fit(X)
+    theirs = getattr(sklearn_rp, theirs_cls_name)(
+        n_components=7, random_state=0
+    ).fit(X)
+    names = ours.get_feature_names_out()
+    np.testing.assert_array_equal(names, theirs.get_feature_names_out())
+    assert names.dtype == object
+
+    # auto-dim: names track the resolved n_components_
+    auto = ours_cls(random_state=0, eps=0.9, backend="numpy").fit(
+        np.random.default_rng(0).normal(size=(50, 2000))
+    )
+    assert len(auto.get_feature_names_out()) == auto.n_components_
+
+    # mismatched input_features is rejected (ClassNamePrefixFeaturesOutMixin
+    # semantics)
+    with pytest.raises(ValueError, match="input_features"):
+        ours.get_feature_names_out(["a", "b"])
+    # a correctly-sized input_features list is accepted (names unchanged)
+    np.testing.assert_array_equal(
+        ours.get_feature_names_out([f"f{i}" for i in range(96)]), names
+    )
+
+
+def test_get_feature_names_out_requires_fit():
+    from randomprojection_tpu import CountSketch, NotFittedError, SignRandomProjection
+
+    with pytest.raises(NotFittedError):
+        GaussianRandomProjection(4).get_feature_names_out()
+    X = np.zeros((10, 32))
+    assert list(
+        SignRandomProjection(4, random_state=0, backend="numpy")
+        .fit(X).get_feature_names_out()
+    ) == [f"signrandomprojection{i}" for i in range(4)]
+    assert list(
+        CountSketch(3, random_state=0, backend="numpy")
+        .fit(X).get_feature_names_out()
+    ) == ["countsketch0", "countsketch1", "countsketch2"]
+
+
 def test_device_hamming_matches_host():
     from randomprojection_tpu import pairwise_hamming, pairwise_hamming_device
 
