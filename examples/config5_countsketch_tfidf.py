@@ -30,29 +30,57 @@ def synth_docs(lo, hi, vocab=50_000):
         yield tf
 
 
+def synth_token_columns(lo, hi, vocab=50_000):
+    """The vectorized ingest layout: one flat token array + CSR indptr per
+    batch (what a real tokenizer pipeline hands over) — no Python dicts."""
+    rng = np.random.default_rng(lo)
+    lens = rng.integers(20, 120, size=hi - lo)
+    flat = rng.integers(0, vocab, size=int(lens.sum()))
+    tokens = np.char.add("w", flat.astype("U7"))
+    indptr = np.concatenate([[0], np.cumsum(lens)])
+    return tokens, indptr
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", choices=["small", "full"], default="small")
+    ap.add_argument(
+        "--ingest", choices=["dict", "tokens"], default="tokens",
+        help="'tokens' = vectorized transform_tokens path (C++ batch "
+        "murmur3, no per-token Python); 'dict' = the per-sample dict API",
+    )
     args = ap.parse_args()
     n_docs = 200_000 if args.scale == "full" else 10_000
     hash_dim, k, batch = 2**18, 256, 2000
 
-    hasher = FeatureHasher(n_features=hash_dim, input_type="dict")
+    hasher = FeatureHasher(
+        n_features=hash_dim,
+        input_type="dict" if args.ingest == "dict" else "string",
+    )
     cs = CountSketch(k, random_state=0).fit_schema(n_docs, hash_dim)
 
     t0 = time.perf_counter()
-    done, checksum = 0, 0.0
+    done, checksum, tokens_seen = 0, 0.0, 0
     while done < n_docs:
         hi = min(done + batch, n_docs)
-        X = hasher.transform(synth_docs(done, hi))     # CSR, hashed
+        if args.ingest == "dict":
+            X = hasher.transform(synth_docs(done, hi))  # CSR, hashed
+        else:
+            toks, indptr = synth_token_columns(done, hi)
+            tokens_seen += len(toks)
+            X = hasher.transform_tokens(toks, indptr)   # one FFI call
         Y = cs.transform(X)                             # (batch, k) sketch
         checksum += float(Y[0, 0])
         done = hi
     dt = time.perf_counter() - t0
-    print(json.dumps({
+    out = {
         "config": 5, "docs": n_docs, "hash_dim": hash_dim, "k": k,
-        "docs_per_s": round(n_docs / dt, 1), "checksum": checksum,
-    }))
+        "ingest": args.ingest, "docs_per_s": round(n_docs / dt, 1),
+        "checksum": checksum,
+    }
+    if tokens_seen:
+        out["tokens_per_s"] = round(tokens_seen / dt, 1)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -140,6 +140,76 @@ def measure_distortion(jax, jnp, R_f32, x_cpu, name, scale):
     return float(np.max(np.abs(pdist2(y_dev) / pdist2(y_ref) - 1.0)))
 
 
+def measure_config5(rows: int = 8192, d: int = 4096, k: int = 256,
+                    n_tokens: int = 2_000_000) -> dict:
+    """Config-5 throughputs (SURVEY.md §1: streaming TF-IDF hashing).
+
+    - ``ingest_tokens_per_s``: host feature-hashing of a flat token column
+      through the vectorized ``transform_tokens`` path (C++ murmur3, one
+      FFI call per batch).
+    - ``countsketch_rows_per_s``: the device CountSketch kernel (MXU
+      one-hot split2), data-resident like the headline modes — streamed
+      feeding is a separate, PCIe-bound number (SURVEY.md §7 R3; on this
+      tunneled dev chip host transfers measure the tunnel, not the chip).
+    """
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.models.sketch import CountSketch
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+
+    rng = np.random.default_rng(0)
+    words = np.asarray([f"tok{i}" for i in range(50_000)])
+    toks = words[rng.integers(0, len(words), size=n_tokens)]
+    indptr = np.arange(0, n_tokens + 1, 100, dtype=np.int64)
+    fh = FeatureHasher(n_features=1 << 20, input_type="string")
+    fh.transform_tokens(toks[:1000])  # warm: builds the .so on first use
+    t0 = time.perf_counter()
+    fh.transform_tokens(toks, indptr)
+    ingest = n_tokens / (time.perf_counter() - t0)
+
+    import jax
+
+    cs = CountSketch(k, random_state=0, backend="jax").fit_schema(
+        rows, d, np.float32
+    )
+    X = rng.normal(size=(rows, d)).astype(np.float32)
+    cs._transform_dense_jax(X[:8])  # builds cs._jax_fn
+    fn = cs._jax_fn
+    steps, calls = 8, 3
+
+    # same anti-caching scan harness as measure_mode (chained steps,
+    # per-call distinct values, serialized on a carry)
+    @jax.jit
+    def run_steps(x, carry, call_idx):
+        x = x + (carry * 1e-24 + call_idx * 1e-6).astype(x.dtype)
+
+        def step(x, _):
+            y = fn(x)
+            return x + (y[:, :1] * 1e-24).astype(x.dtype), y[0, 0]
+
+        _, ys = jax.lax.scan(step, x, None, length=steps)
+        return ys.sum()
+
+    x0 = jnp.asarray(X)
+    carry = run_steps(x0, jnp.float32(0), jnp.float32(-1))  # warm / compile
+    carry.block_until_ready()
+    t0 = time.perf_counter()
+    for c in range(calls):
+        carry = run_steps(x0, carry, jnp.float32(c))
+    carry.block_until_ready()
+    sketch = calls * steps * rows / (time.perf_counter() - t0)
+    kernel = (
+        "onehot_split2" if 2 * k * d <= cs._MXU_MASK_BYTES_CAP else "scatter"
+    )
+    return {
+        "ingest_tokens_per_s": round(ingest, 1),
+        "countsketch_rows_per_s": round(sketch, 1),
+        "countsketch_kernel": kernel,
+        "hash_space": 1 << 20,
+        "sketch_shape": [d, k],
+    }
+
+
 def run(preset: str = "full", k: int = 256, d: int = 4096,
         density: float = 1.0 / 3.0) -> dict:
     import jax
@@ -208,6 +278,7 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
         "implied_tflops": head["implied_tflops"],
         "timing_suspect": head["timing_suspect"],
         "checksum": head["checksum"],
+        "config5": measure_config5(),
     }
 
 

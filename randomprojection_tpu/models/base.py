@@ -76,8 +76,10 @@ def _feature_names_out(est, input_features=None):
             f"seen during fit ({est.n_features_in_}), got {len(input_features)}"
         )
     prefix = type(est).__name__.lower()
+    # one name per actual output column: n_components_ for coordinate
+    # estimators (sklearn parity), ceil(k/8) for packed sign codes
     return np.asarray(
-        [f"{prefix}{i}" for i in range(est.n_components_)], dtype=object
+        [f"{prefix}{i}" for i in range(est._stream_out_width())], dtype=object
     )
 
 

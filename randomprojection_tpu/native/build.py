@@ -73,5 +73,16 @@ def load_murmur3() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p,   # int32 out_idx
         ctypes.c_void_p,   # int8 out_sign
     ]
+    lib.hash_tokens_strided.restype = None
+    lib.hash_tokens_strided.argtypes = [
+        ctypes.c_void_p,   # fixed-width token buffer ('S<w>' array data)
+        ctypes.c_int64,    # stride (itemsize)
+        ctypes.c_void_p,   # int64 lengths
+        ctypes.c_int64,    # n_tokens
+        ctypes.c_uint32,   # seed
+        ctypes.c_uint32,   # n_features
+        ctypes.c_void_p,   # int32 out_idx
+        ctypes.c_void_p,   # int8 out_sign
+    ]
     _lib = lib
     return _lib

@@ -78,4 +78,21 @@ void hash_tokens(const uint8_t* buf, const int64_t* offsets, int64_t n_tokens,
   }
 }
 
+// Strided batch: token i = buf[i*stride, i*stride + lengths[i]).  This is
+// the zero-copy layout of a numpy fixed-width bytes ('S<w>') array, so a
+// whole token column ingests in ONE call with no per-token Python work —
+// the vectorized path for the streaming TF-IDF workload.
+void hash_tokens_strided(const uint8_t* buf, int64_t stride,
+                         const int64_t* lengths, int64_t n_tokens,
+                         uint32_t seed, uint32_t n_features,
+                         int32_t* out_idx, int8_t* out_sign) {
+  for (int64_t i = 0; i < n_tokens; i++) {
+    const int32_t h = static_cast<int32_t>(
+        murmur3_32(buf + i * stride, lengths[i], seed));
+    const int64_t habs = h < 0 ? -static_cast<int64_t>(h) : h;
+    out_idx[i] = static_cast<int32_t>(habs % n_features);
+    out_sign[i] = h >= 0 ? 1 : -1;
+  }
+}
+
 }  // extern "C"

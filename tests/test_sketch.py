@@ -109,11 +109,25 @@ def test_pairwise_hamming_matches_bruteforce():
 # ---------------------------------------------------------------------------
 
 
-def test_countsketch_dense_backends_identical():
+def test_countsketch_dense_backends_agree():
+    """Same h_/s_ on both backends ⇒ the same sketch; the jax MXU path
+    (one-hot split2) agrees at f32 grade with the host scatter.  Error
+    model: each split term carries ~|x|·2^-16, a bucket sums ~d/k of them
+    → atol ~1e-4 for O(1) inputs at d/k≈5."""
     X = np.random.default_rng(0).normal(size=(40, 300)).astype(np.float32)
     Yj = CountSketch(64, random_state=0, backend="jax").fit(X).transform(X)
     Yn = CountSketch(64, random_state=0, backend="numpy").fit(X).transform(X)
-    np.testing.assert_allclose(Yj, Yn, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(Yj, Yn, rtol=1e-4, atol=2e-4)
+
+
+def test_countsketch_scatter_fallback_above_mask_cap(monkeypatch):
+    """Huge hashed feature spaces must take the scatter path (the one-hot
+    matrix would not fit); results still agree with the host scatter."""
+    monkeypatch.setattr(CountSketch, "_MXU_MASK_BYTES_CAP", 1024)
+    X = np.random.default_rng(0).normal(size=(20, 300)).astype(np.float32)
+    Yj = CountSketch(16, random_state=0, backend="jax").fit(X).transform(X)
+    Yn = CountSketch(16, random_state=0, backend="numpy").fit(X).transform(X)
+    np.testing.assert_allclose(Yj, Yn, rtol=2e-5, atol=2e-5)
 
 
 def test_countsketch_csr_matches_dense():

@@ -175,10 +175,12 @@ def test_get_feature_names_out_requires_fit():
     with pytest.raises(NotFittedError):
         GaussianRandomProjection(4).get_feature_names_out()
     X = np.zeros((10, 32))
-    assert list(
-        SignRandomProjection(4, random_state=0, backend="numpy")
-        .fit(X).get_feature_names_out()
-    ) == [f"signrandomprojection{i}" for i in range(4)]
+    # sign codes are packed 8 bits/byte: names track the actual transform
+    # output columns (ceil(k/8) uint8 columns), not the bit count
+    sign_est = SignRandomProjection(16, random_state=0, backend="numpy").fit(X)
+    names = sign_est.get_feature_names_out()
+    assert list(names) == ["signrandomprojection0", "signrandomprojection1"]
+    assert len(names) == sign_est.transform(X).shape[1]
     assert list(
         CountSketch(3, random_state=0, backend="numpy")
         .fit(X).get_feature_names_out()
