@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from randomprojection_tpu.ops.split_matmul import split_f32_to_bf16_pair
 from randomprojection_tpu.utils.validation import check_density, check_input_size
 
 __all__ = ["BLOCK_D", "BLOCK_N", "fused_sparse_project", "pallas_sparse_matrix"]
@@ -82,7 +83,11 @@ def _mask_block(density):
     return gen
 
 
-def _project_kernel(seed_ref, x_ref, o_ref, *, k, density, scale, n_blocks_d):
+_DOT_KD = (((1,), (1,)), ((), ()))  # x[n,d] · r[k,d] → [n,k]
+
+
+def _project_kernel(seed_ref, x_ref, o_ref, *, k, density, scale, n_blocks_d,
+                    mxu_mode):
     j = pl.program_id(1)
     # (seed, global block) → bits: row-tile-free.  seed_ref[1] is the
     # column-block offset of this shard under feature-axis TP (0 unsharded),
@@ -95,12 +100,30 @@ def _project_kernel(seed_ref, x_ref, o_ref, *, k, density, scale, n_blocks_d):
     def _():
         o_ref[:] = jnp.zeros_like(o_ref)
 
-    o_ref[:] += jax.lax.dot_general(
-        x_ref[:],
-        r,
-        dimension_numbers=(((1,), (1,)), ((), ())),  # x[n,d] · r[k,d] → [n,k]
-        preferred_element_type=jnp.float32,
-    )
+    if mxu_mode == "split2":
+        # Split-precision contraction entirely in VMEM (the route to the T1
+        # roofline, BASELINE.json:5): the mask is exact in bf16, X is split
+        # into hi/lo bf16 halves by the shared mantissa-bitmask helper
+        # (``ops/split_matmul.py`` — here with zero HBM roundtrip for the
+        # halves), and two single-pass bf16 MXU contractions accumulate in
+        # f32 — f32-grade output at 2 MXU passes per block, no R and no
+        # X-halves traffic in HBM.
+        x_hi, x_lo = split_f32_to_bf16_pair(x_ref[:])
+        r16 = r.astype(jnp.bfloat16)  # exact: entries are {+1, -1, 0}
+        acc = jax.lax.dot_general(
+            x_hi, r16, dimension_numbers=_DOT_KD,
+            preferred_element_type=jnp.float32,
+        )
+        acc += jax.lax.dot_general(
+            x_lo, r16, dimension_numbers=_DOT_KD,
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[:] += acc
+    else:
+        o_ref[:] += jax.lax.dot_general(
+            x_ref[:], r, dimension_numbers=_DOT_KD,
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(j == n_blocks_d - 1)
     def _():
@@ -115,7 +138,9 @@ def _matrix_kernel(seed_ref, o_ref, *, k, density, scale):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("seed", "n_components", "density", "block_n", "interpret"),
+    static_argnames=(
+        "seed", "n_components", "density", "block_n", "mxu_mode", "interpret",
+    ),
 )
 def fused_sparse_project(
     x,
@@ -125,6 +150,7 @@ def fused_sparse_project(
     *,
     block_n: int = BLOCK_N,
     block_offset=0,
+    mxu_mode: str = "f32",
     interpret: bool = False,
 ):
     """``Y = X @ R(seed)ᵀ`` with ``R`` regenerated in-kernel, never in HBM.
@@ -141,7 +167,17 @@ def fused_sparse_project(
     product against exactly its own blocks of the global matrix.  The
     per-call scale is linear, so ``psum`` of the scaled partials equals the
     unsharded result.
+
+    ``mxu_mode`` selects the contraction arithmetic — NOT part of the matrix
+    definition (both modes contract the identical mask):
+
+    - ``'f32'``: f32 dot at Mosaic's default precision (bf16-grade output).
+    - ``'split2'``: X split hi/lo bf16 in VMEM vs the exact-in-bf16 mask —
+      2 single-pass MXU contractions, f32-grade output (~1e-6 distortion),
+      the mode that reaches the T1 roofline (~R1/2 ≈ 47-94M rows/s).
     """
+    if mxu_mode not in ("f32", "split2"):
+        raise ValueError(f"mxu_mode must be 'f32' or 'split2', got {mxu_mode!r}")
     density = check_density(density, x.shape[1])
     check_input_size(n_components, x.shape[1])
     if n_components % 8:
@@ -167,7 +203,8 @@ def fused_sparse_project(
     )
     y = pl.pallas_call(
         functools.partial(
-            _project_kernel, k=k, density=density, scale=scale, n_blocks_d=nj
+            _project_kernel, k=k, density=density, scale=scale, n_blocks_d=nj,
+            mxu_mode=mxu_mode,
         ),
         grid=(ni, nj),
         in_specs=[
@@ -183,7 +220,9 @@ def fused_sparse_project(
         ),
         out_shape=jax.ShapeDtypeStruct((x.shape[0], k), jnp.float32),
         cost_estimate=pl.CostEstimate(
-            flops=2 * x.shape[0] * x.shape[1] * k,
+            # split2 executes two MXU contractions per block
+            flops=(2 if mxu_mode == "split2" else 1)
+            * 2 * x.shape[0] * x.shape[1] * k,
             bytes_accessed=x.shape[0] * x.shape[1] * 4 + x.shape[0] * k * 4,
             transcendentals=0,
         ),

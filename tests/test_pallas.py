@@ -57,6 +57,53 @@ def test_mask_distribution():
 
 
 @requires_tpu
+@pytest.mark.parametrize("density", [1.0, 1 / 3, 0.05])
+def test_fused_split2_f32_grade(x, density):
+    """mxu_mode='split2' contracts the SAME matrix as 'f32' but at f32-grade
+    accuracy (X split hi/lo bf16 in VMEM vs the exact-in-bf16 mask): the
+    output must match X @ Rᵀ far tighter than the one-pass mode can."""
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.ops.pallas_kernels import (
+        fused_sparse_project,
+        pallas_sparse_matrix,
+    )
+
+    k = 32
+    y = np.asarray(
+        fused_sparse_project(jnp.asarray(x), 42, k, density, mxu_mode="split2")
+    )
+    R = np.asarray(pallas_sparse_matrix(42, k, x.shape[1], density))
+    ref = x.astype(np.float64) @ R.astype(np.float64).T
+    # split2: exact ±1/0 products, error only from the lo-half bf16 rounding
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+    # and it is the same matrix the f32 mode contracts (bf16-grade agreement)
+    y_f32 = np.asarray(fused_sparse_project(jnp.asarray(x), 42, k, density))
+    np.testing.assert_allclose(y, y_f32, rtol=5e-3, atol=0.05)
+
+
+@requires_tpu
+def test_fused_split2_deterministic(x):
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.ops.pallas_kernels import fused_sparse_project
+
+    a = np.asarray(
+        fused_sparse_project(jnp.asarray(x), 7, 32, 0.25, mxu_mode="split2")
+    )
+    b = np.asarray(
+        fused_sparse_project(jnp.asarray(x), 7, 32, 0.25, mxu_mode="split2")
+    )
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(
+        fused_sparse_project(
+            jnp.asarray(x), 7, 32, 0.25, block_n=128, mxu_mode="split2"
+        )
+    )
+    np.testing.assert_array_equal(a, c)  # row tiling is not part of the matrix
+
+
+@requires_tpu
 def test_determinism_and_row_tile_independence(x):
     import jax.numpy as jnp
 
@@ -94,6 +141,8 @@ def test_validation():
         fused_sparse_project(x, 0, 12, 0.5)
     with pytest.raises(ValueError, match="density"):
         fused_sparse_project(x, 0, 16, 1.5)
+    with pytest.raises(ValueError, match="mxu_mode"):
+        fused_sparse_project(x, 0, 16, 0.5, mxu_mode="f64")
 
 
 def test_structural_invariants_everywhere():
@@ -129,13 +178,17 @@ def test_structural_invariants_everywhere():
     assert _seed_to_i32(-1) == -1
 
     # ragged n and d are padded to (block_n, BLOCK_D) multiples internally
-    # and sliced back: output shape must be exact for any input shape
+    # and sliced back: output shape must be exact for any input shape, in
+    # both MXU modes (the mode changes arithmetic, never the contract)
     for n, d, k in [(300, 700, 32), (1, 1, 8), (256, 512, 64), (257, 513, 8)]:
-        out = jax.eval_shape(
-            lambda a, k=k: fused_sparse_project(a, 0, k, 0.5),
-            jax.ShapeDtypeStruct((n, d), jnp.float32),
-        )
-        assert out.shape == (n, k) and out.dtype == jnp.float32
+        for mode in ("f32", "split2"):
+            out = jax.eval_shape(
+                lambda a, k=k, mode=mode: fused_sparse_project(
+                    a, 0, k, 0.5, mxu_mode=mode
+                ),
+                jax.ShapeDtypeStruct((n, d), jnp.float32),
+            )
+            assert out.shape == (n, k) and out.dtype == jnp.float32
         R = jax.eval_shape(
             lambda k=k, d=d: pallas_sparse_matrix(0, k, d, 0.5)
         )
@@ -174,6 +227,40 @@ def test_lazy_backend_end_to_end():
     Xhat = est.inverse_transform(Y)
     np.testing.assert_allclose(
         np.asarray(est.transform(Xhat)), Y, rtol=5e-2, atol=0.1
+    )
+
+
+@requires_tpu
+def test_lazy_split2_backend_end_to_end():
+    """materialization='lazy' × precision='split2': the estimator output must
+    match X @ Rᵀ at f32 grade (the T1 headline path) with no R in HBM."""
+    from randomprojection_tpu import SparseRandomProjection
+    from randomprojection_tpu.backends.jax_backend import _LazyMask
+
+    X = np.random.default_rng(1).normal(size=(200, 1024)).astype(np.float32)
+    common = dict(n_components=64, density=1 / 3, random_state=5, backend="jax")
+    est = SparseRandomProjection(
+        **common,
+        backend_options={"materialization": "lazy", "precision": "split2"},
+    ).fit(X)
+    assert isinstance(est.components_, _LazyMask)  # nothing materialized
+    Y = np.asarray(est.transform(X))
+    R = est.components_as_numpy()
+    np.testing.assert_allclose(Y, X @ R.T, rtol=1e-4, atol=1e-4)
+    # the backend's f32 default precision ('high') maps to the same split2
+    # arithmetic under lazy (Mosaic has no multi-pass f32 dot): bit-identical
+    est_default = SparseRandomProjection(
+        **common, backend_options={"materialization": "lazy"}
+    ).fit(X)
+    np.testing.assert_array_equal(Y, np.asarray(est_default.transform(X)))
+    # explicit precision='default' opts into the single-pass f32 dot:
+    # same matrix, bf16-grade agreement only
+    est_fast = SparseRandomProjection(
+        **common,
+        backend_options={"materialization": "lazy", "precision": "default"},
+    ).fit(X)
+    np.testing.assert_allclose(
+        Y, np.asarray(est_fast.transform(X)), rtol=5e-3, atol=0.05
     )
 
 
