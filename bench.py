@@ -11,12 +11,17 @@ Method
 ------
 - Achlioptas s=3 (density 1/3) projection matrix — the exact 1M×4096→256
   workload of BASELINE.json config 2 — in dense device layout.
-- Three MXU modes are measured; the headline is the FASTEST mode that both
+- Five MXU modes are measured; the headline is the FASTEST mode that both
   meets the ≤1e-3 pairwise-distance budget of BASELINE.json:5 (vs the CPU
   f64 reference, same R) and has a believable timing:
     * ``bf16``: bf16 inputs, f32 accumulation (1 MXU pass, ~1.6e-3+)
     * ``bf16_split2``: X split hi/lo bf16 vs exact ±1 mask (2 passes, ~4e-6)
     * ``f32_high``: f32 inputs, 3-pass bf16 ("high" precision, ~2e-5)
+    * ``lazy``: fused Pallas kernel, mask regenerated in VMEM — zero R HBM
+      traffic (1 f32 pass, ~1e-3; TPU only)
+    * ``lazy_split2``: fused kernel with in-VMEM hi/lo split of X — zero R
+      AND zero X-halves HBM traffic (2 bf16 passes, ~3e-6; TPU only).
+      The roofline-preferred route to the ≥50M rows/s/chip target.
 - Iterations are dependency-chained through the input (x += tiny·y) inside
   one ``lax.scan``, every timed call sees distinct argument values (call
   index folded in on device), calls are serialized through a scalar carry,

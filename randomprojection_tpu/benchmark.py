@@ -31,8 +31,28 @@ def pdist2(a):
     return np.maximum(d2[iu], 1e-30)
 
 
-def _mode_project_fn(jax, jnp, name, scale):
-    """(project(x, r), input_dtype, r_transform) for one MXU mode."""
+def _mode_project_fn(jax, jnp, name, scale, *, k=None, density=None,
+                     lazy_seed=0):
+    """(project(x, r), input_dtype, r_transform) for one MXU mode.
+
+    The ``lazy*`` modes run the fused Pallas kernel
+    (``ops/pallas_kernels.py``): ``r`` is ignored — the mask is regenerated
+    block-by-block in VMEM, so R never exists in HBM.  The caller passes the
+    matching materialized matrix (``pallas_sparse_matrix``) as ``R_f32`` so
+    the distortion reference contracts the identical matrix.
+    """
+    if name in ("lazy", "lazy_split2"):
+        from randomprojection_tpu.ops.pallas_kernels import fused_sparse_project
+
+        mxu_mode = "split2" if name == "lazy_split2" else "f32"
+
+        def project(x, r):  # r unused by design: zero R HBM traffic
+            return fused_sparse_project(
+                x, lazy_seed, k, density, mxu_mode=mxu_mode
+            )
+
+        return project, jnp.float32, lambda R_f32: R_f32
+
     if name == "bf16_split2":
         from randomprojection_tpu.ops.split_matmul import split2_project
 
@@ -58,7 +78,8 @@ def _mode_project_fn(jax, jnp, name, scale):
     return project, dtype, lambda R_f32: R_f32.astype(dtype)
 
 
-def measure_mode(jax, jnp, R_f32, name, scale, batch, steps, calls, d):
+def measure_mode(jax, jnp, R_f32, name, scale, batch, steps, calls, d,
+                 **mode_kw):
     """Time the chained-scan projection loop in one MXU mode.
 
     Anti-caching defenses, per SURVEY.md §7 (this environment's virtualized
@@ -73,7 +94,8 @@ def measure_mode(jax, jnp, R_f32, name, scale, batch, steps, calls, d):
     The caller cross-checks the resulting rate against the hardware peak
     per mode (``implied_tflops`` / ``timing_suspect``).
     """
-    project, in_dtype, r_prep = _mode_project_fn(jax, jnp, name, scale)
+    project, in_dtype, r_prep = _mode_project_fn(jax, jnp, name, scale,
+                                                 **mode_kw)
     r = r_prep(R_f32)
     x0 = jax.random.normal(jax.random.key(1), (batch, d), dtype=in_dtype)
 
@@ -129,9 +151,10 @@ def select_headline(results: dict, budget: float = DISTORTION_BUDGET) -> str:
     return max(eligible, key=lambda n: results[n]["rows_per_s"])
 
 
-def measure_distortion(jax, jnp, R_f32, x_cpu, name, scale):
+def measure_distortion(jax, jnp, R_f32, x_cpu, name, scale, **mode_kw):
     """Max relative pairwise-distance error vs CPU f64, same R."""
-    project, in_dtype, r_prep = _mode_project_fn(jax, jnp, name, scale)
+    project, in_dtype, r_prep = _mode_project_fn(jax, jnp, name, scale,
+                                                 **mode_kw)
     xs = x_cpu[:1024]
     y_dev = np.asarray(
         jax.jit(project)(jnp.asarray(xs, dtype=in_dtype), r_prep(R_f32))
@@ -229,12 +252,36 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
     # effective MXU FLOPs per row differ per mode: bf16 is 1 pass over the
     # contraction, split2 runs it twice, 'high' three times — the peak
     # check must use what the hardware actually executes
-    mxu_passes = {"bf16": 1, "bf16_split2": 2, "f32_high": 3}
+    mxu_passes = {"bf16": 1, "bf16_split2": 2, "f32_high": 3,
+                  "lazy": 1, "lazy_split2": 2}
+
+    # the fused lazy Pallas modes regenerate the mask in VMEM (zero R HBM
+    # traffic — ops/pallas_kernels.py); the pltpu PRNG has no CPU or GPU
+    # emulation, so they run only on a real TPU-family chip (same deny-list
+    # as backends/jax_backend.py's lazy guard: unknown platforms like this
+    # box's virtualized 'axon' are TPU-backed).  Their distortion reference
+    # is the matching materialized matrix (same (seed, block) streams).
+    mode_names = ["bf16", "bf16_split2", "f32_high"]
+    lazy_kw = {}
+    R_by_mode = {}
+    if jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm"):
+        from randomprojection_tpu.ops.pallas_kernels import pallas_sparse_matrix
+
+        lazy_seed = 0
+        R_lazy = pallas_sparse_matrix(lazy_seed, k, d, density)
+        for name in ("lazy", "lazy_split2"):
+            mode_names.append(name)
+            lazy_kw[name] = dict(k=k, density=density, lazy_seed=lazy_seed)
+            R_by_mode[name] = R_lazy
 
     results = {}
-    for name in ("bf16", "bf16_split2", "f32_high"):
-        perf = measure_mode(jax, jnp, R, name, scale, d=d, **cfg)
-        perf["distortion"] = measure_distortion(jax, jnp, R, x_cpu, name, scale)
+    for name in mode_names:
+        kw = lazy_kw.get(name, {})
+        R_mode = R_by_mode.get(name, R)
+        perf = measure_mode(jax, jnp, R_mode, name, scale, d=d, **cfg, **kw)
+        perf["distortion"] = measure_distortion(
+            jax, jnp, R_mode, x_cpu, name, scale, **kw
+        )
         # nominal rate (the comparable rows/s·2dk number) and executed rate
         # (× MXU passes) — the suspect flag keys on the EXECUTED rate
         nominal = perf["rows_per_s"] * 2 * d * k / 1e12

@@ -81,6 +81,10 @@ def build_parser():
     q.add_argument("--d", type=int, default=4096)
     q.add_argument("--k", type=int, default=256)
     q.add_argument("--batch-rows", type=int, default=65536)
+    q.add_argument("--kind", default="gaussian",
+                   choices=["gaussian", "sparse", "sign", "countsketch"])
+    q.add_argument("--density", default="auto")
+    q.add_argument("--eps", type=float, default=0.1)
     _add_common(q)
 
     return p
@@ -118,6 +122,10 @@ def _make_estimator(args):
     opts = _backend_options(args)
     if opts:
         common["backend_options"] = opts
+    if args.kind != "sparse" and getattr(args, "density", "auto") != "auto":
+        # refuse rather than silently drop: only the sparse kind has a
+        # density parameter
+        raise SystemExit(f"--density is not supported for --kind {args.kind}")
     if args.kind == "gaussian":
         return rp.GaussianRandomProjection(k, eps=args.eps, **common)
     if args.kind == "sparse":
@@ -129,6 +137,13 @@ def _make_estimator(args):
         return rp.SignRandomProjection(k, **common)
     if k == "auto":
         raise SystemExit("--kind countsketch requires an explicit --n-components")
+    if opts:
+        # refuse rather than silently drop: CountSketch has no precision/
+        # materialization knobs (the MXU path is already split2-exact)
+        raise SystemExit(
+            "--precision/--materialization are not supported for "
+            "--kind countsketch"
+        )
     return rp.CountSketch(k, random_state=args.seed, backend=args.backend)
 
 
@@ -245,17 +260,18 @@ def cmd_bench(args):
 
 def cmd_stream_bench(args):
     """Host-streamed rows/s: includes h2d (PCIe) — the honest streamed
-    number, which SURVEY.md §7 R3 predicts is transfer-bound."""
+    number, which SURVEY.md §7 R3 predicts is transfer-bound.  The
+    estimator is built by the same ``_make_estimator`` as ``project``, so
+    ``--kind``/``--precision``/``--materialization`` select the identical
+    execution modes the bench's data-resident numbers use."""
     import time
 
-    import randomprojection_tpu as rp
     from randomprojection_tpu.streaming import ArraySource
     from randomprojection_tpu.utils.observability import StreamStats, profile_trace
 
     X = np.random.default_rng(0).normal(size=(args.rows, args.d)).astype(np.float32)
-    est = rp.GaussianRandomProjection(
-        args.k, random_state=args.seed, backend=args.backend
-    ).fit(X)
+    args.n_components = args.k
+    est = _make_estimator(args).fit(X)
     # warmup compile on one batch
     est.transform(X[: min(args.batch_rows, args.rows)])
     stats = StreamStats()
@@ -265,12 +281,14 @@ def cmd_stream_bench(args):
             pass
     elapsed = time.perf_counter() - t0
     print(json.dumps({
-        "metric": f"host-streamed rows/s {args.d}->{args.k}",
+        "metric": f"host-streamed rows/s {args.d}->{args.k} ({args.kind})",
         "value": round(args.rows / elapsed, 1),
         "unit": "rows/s",
+        "kind": args.kind,
+        "backend": args.backend,
+        "backend_options": _backend_options(args),
         "bytes_in": stats.bytes_in,
         "elapsed_s": round(elapsed, 4),
-        "backend": args.backend,
     }))
 
 

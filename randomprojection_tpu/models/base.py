@@ -37,7 +37,49 @@ from randomprojection_tpu.utils.validation import (
     resolve_transform_dtype,
 )
 
-__all__ = ["BaseRandomProjection"]
+__all__ = ["BaseRandomProjection", "ParamsMixin"]
+
+
+class ParamsMixin:
+    """sklearn-compatible ``get_params``/``set_params``/``clone`` support.
+
+    Parameter names are introspected from ``__init__`` the way sklearn does,
+    so subclasses adding constructor params need no override.
+    """
+
+    @classmethod
+    def _get_param_names(cls):
+        import inspect
+
+        sig = inspect.signature(cls.__init__)
+        return sorted(
+            p.name
+            for p in sig.parameters.values()
+            if p.name != "self" and p.kind is not p.VAR_KEYWORD
+        )
+
+    def get_params(self, deep: bool = True) -> dict:
+        """The exact constructor arguments, so ``sklearn.clone(est)``
+        reconstructs an identical unfitted estimator (``deep`` accepted for
+        interface parity; there are no nested estimators)."""
+        return {name: getattr(self, name) for name in self._get_param_names()}
+
+    def set_params(self, **params):
+        """In-place parameter update (enables ``clone``, CV composition).
+        Unknown names raise."""
+        valid = self._get_param_names()
+        for name, value in params.items():
+            if name not in valid:
+                raise ValueError(
+                    f"Invalid parameter {name!r} for estimator "
+                    f"{type(self).__name__}. Valid parameters are: {valid}."
+                )
+            setattr(self, name, value)
+        return self
+
+    def __repr__(self):
+        params = ", ".join(f"{k}={v!r}" for k, v in self.get_params().items())
+        return f"{type(self).__name__}({params})"
 
 
 def _resolve_seed(random_state) -> int:
@@ -83,7 +125,7 @@ def _feature_names_out(est, input_features=None):
     )
 
 
-class BaseRandomProjection:
+class BaseRandomProjection(ParamsMixin):
     """Shared estimator machinery; subclasses define the matrix kind.
 
     Parameters (the reference's kwargs surface, kept fixed per BASELINE.json:5)
@@ -306,15 +348,4 @@ class BaseRandomProjection:
         self._check_is_fitted()
         return self._backend.components_to_numpy(self._state, self.spec_)
 
-    def get_params(self) -> dict:
-        return {
-            "n_components": self.n_components,
-            "eps": self.eps,
-            "compute_inverse_components": self.compute_inverse_components,
-            "random_state": self.random_state,
-            "backend": self.backend if isinstance(self.backend, str) else "custom",
-        }
-
-    def __repr__(self):
-        params = ", ".join(f"{k}={v!r}" for k, v in self.get_params().items())
-        return f"{type(self).__name__}({params})"
+    # get_params / set_params / __repr__ come from ParamsMixin

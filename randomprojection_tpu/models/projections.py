@@ -79,9 +79,3 @@ class SparseRandomProjection(BaseRandomProjection):
 
     def _dense_output(self) -> bool:
         return self.dense_output
-
-    def get_params(self) -> dict:
-        params = super().get_params()
-        params["density"] = self.density
-        params["dense_output"] = self.dense_output
-        return params

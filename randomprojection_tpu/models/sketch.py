@@ -26,7 +26,11 @@ from typing import Optional
 import numpy as np
 import scipy.sparse as sp
 
-from randomprojection_tpu.models.base import BaseRandomProjection, _resolve_seed
+from randomprojection_tpu.models.base import (
+    BaseRandomProjection,
+    ParamsMixin,
+    _resolve_seed,
+)
 from randomprojection_tpu.utils.validation import NotFittedError, check_array
 
 __all__ = [
@@ -144,7 +148,7 @@ def cosine_from_hamming(hamming, n_bits: int):
     return np.cos(np.pi * np.asarray(hamming, dtype=np.float64) / n_bits)
 
 
-class CountSketch:
+class CountSketch(ParamsMixin):
     """Count-Sketch / hashing-trick projection ``(n, d) → (n, k)``.
 
     The hash maps ``h_`` (int32 ``[0, k)``) and signs ``s_`` (±1 int8) are

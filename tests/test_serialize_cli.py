@@ -279,3 +279,65 @@ def test_cli_project_roundtrip(tmp_path):
     Y = np.load(yout)
     ref = GaussianRandomProjection(16, random_state=5, backend="numpy").fit(X)
     np.testing.assert_allclose(Y, np.asarray(ref.transform(X)), rtol=1e-6)
+
+
+def test_stream_bench_kinds_and_flags(capsys):
+    """stream-bench must honor --kind and forward --precision/
+    --materialization into the estimator (round-2 weak #1: the flags were
+    accepted but silently dropped, and the kind was hardcoded gaussian)."""
+    from randomprojection_tpu import cli
+
+    argv = [
+        "stream-bench", "--rows", "512", "--d", "64", "--k", "16",
+        "--batch-rows", "256", "--kind", "sparse", "--density", "0.5",
+        "--backend", "jax", "--precision", "split2",
+    ]
+    # the estimator the command builds carries the flags
+    args = cli.build_parser().parse_args(argv)
+    args.n_components = args.k
+    est = cli._make_estimator(args)
+    assert type(est).__name__ == "SparseRandomProjection"
+    assert est.backend_options == {"precision": "split2"}
+    assert est.density == 0.5
+
+    cli.main(argv)
+    out = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert out["kind"] == "sparse"
+    assert out["backend_options"] == {"precision": "split2"}
+    assert out["value"] > 0
+
+
+def test_stream_bench_sign_kind(capsys):
+    from randomprojection_tpu import cli
+
+    cli.main([
+        "stream-bench", "--rows", "256", "--d", "64", "--k", "16",
+        "--batch-rows", "128", "--kind", "sign", "--backend", "numpy",
+    ])
+    out = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert out["kind"] == "sign" and out["value"] > 0
+
+
+def test_countsketch_rejects_precision_flags():
+    """CountSketch has no precision/materialization knobs: refusing beats
+    silently dropping the flags (flag-honesty contract)."""
+    from randomprojection_tpu import cli
+
+    args = cli.build_parser().parse_args(
+        ["stream-bench", "--kind", "countsketch", "--precision", "high"]
+    )
+    args.n_components = args.k
+    with pytest.raises(SystemExit, match="not supported"):
+        cli._make_estimator(args)
+
+
+def test_density_flag_refused_for_non_sparse_kinds():
+    from randomprojection_tpu import cli
+
+    for kind in ("gaussian", "sign", "countsketch"):
+        args = cli.build_parser().parse_args(
+            ["stream-bench", "--kind", kind, "--density", "0.5"]
+        )
+        args.n_components = args.k
+        with pytest.raises(SystemExit, match="density"):
+            cli._make_estimator(args)
