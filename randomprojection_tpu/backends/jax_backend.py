@@ -335,25 +335,29 @@ class JaxBackend(ProjectionBackend):
         import jax
         import jax.numpy as jnp
 
-        device_resident = isinstance(X, jax.Array)
-        if sp.issparse(X):
-            X = X.toarray()
+        from randomprojection_tpu.utils.observability import annotate
 
-        if device_resident:
-            x = X.astype(jnp.dtype(self.compute_dtype))
-            n = x.shape[0]
-        else:
-            X = np.asarray(X)
-            n = X.shape[0]
-            x = np.ascontiguousarray(X, dtype=self.compute_dtype)
+        with annotate("rp:backend/prepare"):
+            device_resident = isinstance(X, jax.Array)
+            if sp.issparse(X):
+                X = X.toarray()
 
-        pad_to = _pad_rows(n)
-        if pad_to != n:
-            pad = ((0, pad_to - n), (0, 0))
-            x = jnp.pad(x, pad) if device_resident else np.pad(x, pad)
-        row_sharding = self._row_sharding()
-        if not device_resident or row_sharding is not None:
-            x = jax.device_put(x, row_sharding)
+            if device_resident:
+                x = X.astype(jnp.dtype(self.compute_dtype))
+                n = x.shape[0]
+            else:
+                X = np.asarray(X)
+                n = X.shape[0]
+                x = np.ascontiguousarray(X, dtype=self.compute_dtype)
+
+            pad_to = _pad_rows(n)
+            if pad_to != n:
+                pad = ((0, pad_to - n), (0, 0))
+                x = jnp.pad(x, pad) if device_resident else np.pad(x, pad)
+            row_sharding = self._row_sharding()
+            if not device_resident or row_sharding is not None:
+                with annotate("rp:backend/h2d"):
+                    x = jax.device_put(x, row_sharding)
         return x, n, device_resident
 
     def _get_split_fn(self):
@@ -484,7 +488,13 @@ class JaxBackend(ProjectionBackend):
         return fn(y)
 
     def _transform_impl(self, X, state, spec: ProjectionSpec):
+        from randomprojection_tpu.utils.observability import annotate
+
         x, n, device_resident = self._prepare_rows(X)
+        with annotate("rp:backend/project"):
+            return self._project_prepared(x, n, state, spec), device_resident
+
+    def _project_prepared(self, x, n, state, spec: ProjectionSpec):
         if isinstance(state, _SplitMask):
             y = self._get_split_fn()(
                 x.astype(self._jax.numpy.float32), state.mask, state.scale
@@ -513,7 +523,7 @@ class JaxBackend(ProjectionBackend):
                 ).astype(x.dtype)
         else:
             y = self._get_transform_fn()(x, state)
-        return self._slice_rows(y, n), device_resident
+        return self._slice_rows(y, n)
 
     def transform_packed_signs(
         self, X, state, spec: ProjectionSpec, *, materialize: bool = True
@@ -549,8 +559,11 @@ class JaxBackend(ProjectionBackend):
                 )
             y = self._pack_fn(y_coords)
         else:
+            from randomprojection_tpu.utils.observability import annotate
+
             x, n, device_resident = self._prepare_rows(X)
-            y = self._slice_rows(self._sign_fn(x, state), n)
+            with annotate("rp:backend/sign_project"):
+                y = self._slice_rows(self._sign_fn(x, state), n)
         if device_resident or not materialize:
             return y
         return np.asarray(y)

@@ -98,39 +98,14 @@ def measure_mode(jax, jnp, R_f32, name, scale, batch, steps, calls, d,
                                                  **mode_kw)
     r = r_prep(R_f32)
     x0 = jax.random.normal(jax.random.key(1), (batch, d), dtype=in_dtype)
-
-    @jax.jit
-    def run_steps(x, r, carry, call_idx):
-        # fold the call index and the previous call's result into this
-        # call's input: calls can neither be cached (distinct values per
-        # call) nor reordered (serialized on carry)
-        x = x + (carry * 1e-24 + call_idx * 1e-6).astype(x.dtype)
-
-        def step(x, _):
-            y = project(x, r)
-            x = x + (y[:, :1] * 1e-24).astype(x.dtype)
-            return x, y[0, 0]
-
-        _, ys = jax.lax.scan(step, x, None, length=steps)
-        return ys.sum()
-
-    carry = run_steps(x0, r, jnp.float32(0), jnp.float32(-1))  # warmup
-    carry.block_until_ready()
-
-    checks = []
-    t0 = time.perf_counter()
-    for c in range(calls):
-        carry = run_steps(x0, r, carry, jnp.float32(c))
-        checks.append(carry)
-    carry.block_until_ready()
-    elapsed = time.perf_counter() - t0
-
-    rows = calls * steps * batch
+    rate, elapsed, checksum = _scan_harness(
+        jax, jnp, lambda x: project(x, r), x0, steps, calls
+    )
     return {
-        "rows_per_s": rows / elapsed,
+        "rows_per_s": rate,
         "elapsed_s": elapsed,
-        "rows_timed": rows,
-        "checksum": float(np.asarray(jnp.stack(checks)).sum()),
+        "rows_timed": calls * steps * batch,
+        "checksum": checksum,
     }
 
 
@@ -199,28 +174,7 @@ def measure_config5(rows: int = 8192, d: int = 4096, k: int = 256,
     cs._transform_dense_jax(X[:8])  # builds cs._jax_fn
     fn = cs._jax_fn
     steps, calls = 8, 3
-
-    # same anti-caching scan harness as measure_mode (chained steps,
-    # per-call distinct values, serialized on a carry)
-    @jax.jit
-    def run_steps(x, carry, call_idx):
-        x = x + (carry * 1e-24 + call_idx * 1e-6).astype(x.dtype)
-
-        def step(x, _):
-            y = fn(x)
-            return x + (y[:, :1] * 1e-24).astype(x.dtype), y[0, 0]
-
-        _, ys = jax.lax.scan(step, x, None, length=steps)
-        return ys.sum()
-
-    x0 = jnp.asarray(X)
-    carry = run_steps(x0, jnp.float32(0), jnp.float32(-1))  # warm / compile
-    carry.block_until_ready()
-    t0 = time.perf_counter()
-    for c in range(calls):
-        carry = run_steps(x0, carry, jnp.float32(c))
-    carry.block_until_ready()
-    sketch = calls * steps * rows / (time.perf_counter() - t0)
+    sketch, _, _ = _scan_harness(jax, jnp, fn, jnp.asarray(X), steps, calls)
     kernel = (
         "onehot_split2" if 2 * k * d <= cs._MXU_MASK_BYTES_CAP else "scatter"
     )
@@ -230,6 +184,158 @@ def measure_config5(rows: int = 8192, d: int = 4096, k: int = 256,
         "countsketch_kernel": kernel,
         "hash_space": 1 << 20,
         "sketch_shape": [d, k],
+    }
+
+
+def _scan_harness(jax, jnp, project, x0, steps, calls):
+    """The one anti-cache timing loop every throughput number goes through.
+
+    Defenses, per SURVEY.md §7 (this environment's virtualized TPU has been
+    observed serving repeated calls from a cache):
+
+    - every timed call sees DISTINCT argument values: the call index is
+      folded into the input on device (one buffer, no extra HBM);
+    - a scalar carry from call ``i``'s checksum is folded into call
+      ``i+1``'s input, serializing the calls;
+    - within a call, scan steps chain through the input (defeats DCE).
+
+    ``project(x) -> (n, k')`` may return any dtype (sign codes are uint8);
+    the chain casts through f32.  Callers cross-check the resulting rate
+    against the hardware peak (``executed_tflops`` / ``timing_suspect``).
+    """
+    import time as _time
+
+    @jax.jit
+    def run_steps(x, carry, call_idx):
+        # fold the call index and the previous call's result into this
+        # call's input: calls can neither be cached (distinct values per
+        # call) nor reordered (serialized on carry)
+        x = x + (carry * 1e-24 + call_idx * 1e-6).astype(x.dtype)
+
+        def step(x, _):
+            y = project(x)
+            x = x + (y[:, :1].astype(jnp.float32) * 1e-24).astype(x.dtype)
+            return x, y[0, 0].astype(jnp.float32)
+
+        _, ys = jax.lax.scan(step, x, None, length=steps)
+        return ys.sum()
+
+    carry = run_steps(x0, jnp.float32(0), jnp.float32(-1))  # warmup/compile
+    carry.block_until_ready()
+    checks = []
+    t0 = _time.perf_counter()
+    for c in range(calls):
+        carry = run_steps(x0, carry, jnp.float32(c))
+        checks.append(carry)
+    carry.block_until_ready()
+    elapsed = _time.perf_counter() - t0
+    rows = calls * steps * x0.shape[0]
+    return rows / elapsed, elapsed, float(np.asarray(jnp.stack(checks)).sum())
+
+
+def measure_config3(preset: str = "full") -> dict:
+    """Config-3 (BASELINE.json:9): very-sparse Li RP ``16384→512`` at
+    ``density = 1/√d = 1/128``, data-resident, via the fused lazy Pallas
+    kernel in split2 mode — R (512×16384 = 32 MiB f32) never exists in HBM.
+    TPU-only (the in-kernel PRNG has no CPU/GPU emulation); the TP variant
+    of the same kernel is exercised by the multichip dryrun.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.ops.pallas_kernels import (
+        fused_sparse_project,
+        pallas_sparse_matrix,
+    )
+
+    d, k = 16384, 512
+    density = 1.0 / math.sqrt(d)
+    cfg = dict(batch=16384, steps=4, calls=3) if preset == "full" else dict(
+        batch=2048, steps=2, calls=2
+    )
+
+    def project(x):
+        return fused_sparse_project(x, 0, k, density, mxu_mode="split2")
+
+    x0 = jax.random.normal(jax.random.key(3), (cfg["batch"], d), jnp.float32)
+    rate, elapsed, checksum = _scan_harness(
+        jax, jnp, project, x0, cfg["steps"], cfg["calls"]
+    )
+
+    # distortion vs CPU f64 contraction of the identical lazy matrix
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(512, d)).astype(np.float32)
+    y_dev = np.asarray(jax.jit(project)(jnp.asarray(xs))).astype(np.float64)
+    R = np.asarray(pallas_sparse_matrix(0, k, d, density)).astype(np.float64)
+    distortion = float(np.max(np.abs(pdist2(y_dev) / pdist2(xs @ R.T) - 1.0)))
+
+    executed = rate * 2 * 2 * d * k / 1e12  # split2: 2 MXU passes
+    return {
+        "workload": f"verysparse Li density=1/{int(math.sqrt(d))} {d}->{k}, lazy_split2",
+        "rows_per_s": round(rate, 1),
+        "distortion": distortion,
+        "elapsed_s": round(elapsed, 4),
+        "rows_timed": cfg["batch"] * cfg["steps"] * cfg["calls"],
+        "executed_tflops": round(executed, 1),
+        "timing_suspect": bool(executed > 2 * V5E_PEAK_TFLOPS),
+        "checksum": checksum,
+    }
+
+
+def measure_config4(preset: str = "full") -> dict:
+    """Config-4 (BASELINE.json:10): SimHash sign-RP ``768→256`` including
+    the on-device sign+packbits cost (output is 32 uint8 bytes/row — the
+    96× d2h shrink that makes the 1B-row workload feasible).  Quality is
+    reported as the sign-bit mismatch rate vs the CPU f64 projection of the
+    same R (boundary flips only — there is no distance distortion for
+    codes).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.ops import kernels
+
+    d, k = 768, 256
+    cfg = dict(batch=131072, steps=8, calls=3) if preset == "full" else dict(
+        batch=8192, steps=2, calls=2
+    )
+    R = kernels.gaussian_matrix(jax.random.key(7), k, d, jnp.float32)
+
+    @jax.jit
+    def project(x):
+        y = jnp.einsum(
+            "nd,kd->nk", x, R,
+            preferred_element_type=jnp.float32, precision="high",
+        )
+        return jnp.packbits(y > 0, axis=-1, bitorder="little")
+
+    x0 = jax.random.normal(jax.random.key(4), (cfg["batch"], d), jnp.float32)
+    rate, elapsed, checksum = _scan_harness(
+        jax, jnp, project, x0, cfg["steps"], cfg["calls"]
+    )
+
+    rng = np.random.default_rng(4)
+    xs = rng.normal(size=(2048, d)).astype(np.float32)
+    codes_dev = np.asarray(jax.jit(project)(jnp.asarray(xs)))
+    ref = xs.astype(np.float64) @ np.asarray(R, dtype=np.float64).T
+    codes_ref = np.packbits(ref > 0, axis=-1, bitorder="little")
+    mismatch = float(
+        np.bitwise_count(codes_dev ^ codes_ref).sum() / (codes_ref.shape[0] * k)
+    )
+
+    executed = rate * 3 * 2 * d * k / 1e12  # 'high' = 3 MXU passes
+    return {
+        "workload": f"simhash sign-RP {d}->{k} packed uint8, f32_high",
+        "rows_per_s": round(rate, 1),
+        "sign_mismatch_rate_vs_cpu": mismatch,
+        "elapsed_s": round(elapsed, 4),
+        "rows_timed": cfg["batch"] * cfg["steps"] * cfg["calls"],
+        "executed_tflops": round(executed, 1),
+        "timing_suspect": bool(executed > 2 * V5E_PEAK_TFLOPS),
+        "checksum": checksum,
+        "code_bytes_per_row": k // 8,
     }
 
 
@@ -325,6 +431,14 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
         "implied_tflops": head["implied_tflops"],
         "timing_suspect": head["timing_suspect"],
         "checksum": head["checksum"],
+        # per-config tracked numbers (BASELINE.json:9-11) so every workload
+        # has a recorded throughput; config3 needs the TPU-only lazy kernel
+        **(
+            {"config3": measure_config3(preset)}
+            if jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm")
+            else {}
+        ),
+        "config4": measure_config4(preset),
         "config5": measure_config5(),
     }
 

@@ -35,6 +35,12 @@ def _add_common(p):
                    choices=["debug", "info", "warning", "error"])
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="jax.config jax_debug_nans: fail fast on NaN/Inf "
+                        "produced by any jitted computation")
+    p.add_argument("--disable-jit", action="store_true",
+                   help="jax.config jax_disable_jit: run op-by-op for "
+                        "debugging (orders slower)")
 
 
 def _backend_options(args) -> dict:
@@ -296,6 +302,15 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     if hasattr(args, "log_level"):
         logging.basicConfig(level=getattr(logging, args.log_level.upper()))
+    # debug switches (SURVEY.md §6): applied before any jax computation
+    if getattr(args, "debug_nans", False):
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+    if getattr(args, "disable_jit", False):
+        import jax
+
+        jax.config.update("jax_disable_jit", True)
     return {
         "jl-dim": cmd_jl_dim,
         "info": cmd_info,

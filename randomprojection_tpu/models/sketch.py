@@ -157,7 +157,8 @@ class CountSketch(ParamsMixin):
     unlike the JL kernels, where each backend has its own PRNG —
     SURVEY.md §8).  Numeric agreement across backends is f32-grade
     (≲1e-5 relative) on the MXU path; f64 inputs stay on host and agree
-    exactly.
+    exactly.  Pass ``use_mxu=False`` to force the scatter path when exact
+    cross-backend reproducibility matters more than throughput.
 
     Dense f32 inputs on the jax backend run on the MXU as a one-hot ±1
     matmul (split-precision, see ``_transform_dense_jax`` for the measured
@@ -167,7 +168,8 @@ class CountSketch(ParamsMixin):
     ``_hashing_fast.pyx``).
     """
 
-    def __init__(self, n_components, *, random_state=None, backend="auto"):
+    def __init__(self, n_components, *, random_state=None, backend="auto",
+                 use_mxu: Optional[bool] = None):
         if not isinstance(n_components, numbers.Integral) or n_components <= 0:
             raise ValueError(
                 f"n_components must be a positive int, got {n_components!r}"
@@ -175,6 +177,12 @@ class CountSketch(ParamsMixin):
         self.n_components = int(n_components)
         self.random_state = random_state
         self.backend = backend
+        # None = auto (MXU one-hot matmul when the mask fits the size cap);
+        # False = force the device scatter path — the opt-out for users who
+        # need the pre-MXU exact cross-backend reproducibility (the MXU path
+        # agrees with numpy at f32 grade only); True = require the MXU path
+        # (raises at transform if the mask would exceed the cap).
+        self.use_mxu = use_mxu
 
     def fit_schema(self, n_samples: int, n_features: int, dtype=np.float64):
         if n_features <= 0:
@@ -188,11 +196,28 @@ class CountSketch(ParamsMixin):
         self.n_features_in_ = n_features
         self.h_ = rng.integers(0, self.n_components, size=n_features, dtype=np.int32)
         self.s_ = (rng.integers(0, 2, size=n_features, dtype=np.int8) * 2 - 1)
+        self._resolve_execution()
+        return self
+
+    def _resolve_execution(self):
+        """(Re)derive the execution path from backend/use_mxu and drop any
+        cached device fn.  Called at fit and whenever ``set_params`` touches
+        an execution-affecting parameter — the cached ``_jax_fn`` has the
+        old one-hot mask / path choice baked in."""
         self._use_jax = self.backend in ("jax", "auto") and _jax_available()
-        # a refit draws new h_/s_ (and possibly a new shape): the cached
-        # device fn has the old one-hot mask baked in — drop it
-        if hasattr(self, "_jax_fn"):
-            del self._jax_fn
+        if self.use_mxu and not self._use_jax:
+            # refuse rather than silently scattering on the host —
+            # the documented use_mxu=True semantics are "require the MXU"
+            raise ValueError(
+                "use_mxu=True requires the jax backend (backend='jax' or "
+                f"'auto' with jax importable), got backend={self.backend!r}"
+            )
+        self.__dict__.pop("_jax_fn", None)
+
+    def set_params(self, **params):
+        super().set_params(**params)
+        if {"use_mxu", "backend"} & params.keys():
+            self._resolve_execution()
         return self
 
     def fit(self, X, y=None):
@@ -208,6 +233,11 @@ class CountSketch(ParamsMixin):
     def transform(self, X):
         self._check_is_fitted()
         if sp.issparse(X):
+            if self.use_mxu:
+                raise ValueError(
+                    "use_mxu=True cannot serve sparse input (the MXU path "
+                    "is dense-only); densify X or use use_mxu=None"
+                )
             return self._transform_csr(X.tocsr())
         X = check_array(X, accept_sparse=False)
         if X.shape[1] != self.n_features_in_:
@@ -235,6 +265,11 @@ class CountSketch(ParamsMixin):
         if X.dtype == np.float64:
             # jax (x64 disabled) would silently truncate to f32, breaking
             # the documented numpy/jax agreement; f64 stays on host
+            if self.use_mxu:
+                raise ValueError(
+                    "use_mxu=True cannot serve float64 input (jax would "
+                    "truncate to f32); cast X to float32 or use use_mxu=None"
+                )
             return self._transform_dense_np(X)
         import jax
         import jax.numpy as jnp
@@ -242,7 +277,14 @@ class CountSketch(ParamsMixin):
         if not hasattr(self, "_jax_fn"):
             k, d = self.n_components_, self.n_features_in_
 
-            if 2 * k * d <= self._MXU_MASK_BYTES_CAP:
+            fits_cap = 2 * k * d <= self._MXU_MASK_BYTES_CAP
+            if self.use_mxu and not fits_cap:
+                raise ValueError(
+                    f"use_mxu=True but the one-hot mask ({2 * k * d} bytes "
+                    f"bf16) exceeds the {self._MXU_MASK_BYTES_CAP}-byte cap; "
+                    "use use_mxu=None (auto) or False (scatter)"
+                )
+            if fits_cap if self.use_mxu is None else self.use_mxu:
                 # MXU path: CountSketch IS a projection with a one-hot ±1
                 # matrix M[h(j), j] = s(j) — exact in bf16, so the split2
                 # two-pass matmul gives f32-grade output.  Measured on the

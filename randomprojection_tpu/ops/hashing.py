@@ -81,6 +81,22 @@ def _reject_token(t):
     )
 
 
+def _nul_scan(mat2d: np.ndarray):
+    """One pass over a fixed-width token buffer → ``(has_embedded_nul,
+    first_nul_lengths int64)``.
+
+    A row has an embedded NUL if any element after its first zero is
+    nonzero — there first-NUL truncation (the strided kernel's length rule)
+    would disagree with numpy's trailing-pad-strip item semantics.  The
+    lengths double as the strided kernel's per-token lengths, so the hot
+    path scans the buffer exactly once."""
+    nz = mat2d != 0
+    lengths = np.where(
+        nz.all(axis=1), mat2d.shape[1], nz.argmin(axis=1)
+    ).astype(np.int64)
+    return bool(np.any(nz.sum(axis=1) != lengths)), lengths
+
+
 def _hash_token_array(arr: np.ndarray, n_features: int, seed: int):
     """Vectorized hashing of a numpy ``U``/``S`` token array.
 
@@ -90,9 +106,11 @@ def _hash_token_array(arr: np.ndarray, n_features: int, seed: int):
     narrowed UCS-4→uint8 with one C-level cast (~an order of magnitude
     faster than ``np.char.encode``); non-ASCII falls back to utf-8 encode.
 
-    Caveat (inherent to numpy's fixed-width dtypes, which right-strip
-    NULs): a token containing NUL bytes is treated as ending at the first
-    NUL.  Such tokens need the list path.
+    Tokens containing embedded NUL bytes cannot take the strided path
+    (numpy's fixed-width NUL padding is indistinguishable from content):
+    they are detected up front and the whole column is routed through the
+    list path, so every path hashes such tokens identically (all bytes up
+    to the trailing pad — numpy's own item-access semantics).
     """
     if arr.ndim != 1:
         arr = arr.ravel()
@@ -104,28 +122,34 @@ def _hash_token_array(arr: np.ndarray, n_features: int, seed: int):
 
     lib = load_murmur3()
     buf = None
+    lengths = None
     if arr.dtype.kind == "U":
         w = arr.dtype.itemsize // 4
         codes = np.ascontiguousarray(arr).view(np.uint32).reshape(n, w)
+        embedded, ulens = _nul_scan(codes)
+        if embedded:
+            return hash_tokens(arr.tolist(), n_features, seed)
         if lib is not None and int(codes.max(initial=0)) < 128:
             buf = codes.astype(np.uint8)  # ASCII narrow: one C cast
+            lengths = ulens  # ASCII ⇒ byte length == code-unit length
         else:
+            # utf-8 of NUL-free text contains no zero bytes, so the S-path
+            # below cannot re-trip the embedded-NUL routing
             arr = np.char.encode(arr, "utf-8")
     if buf is None:
         arr = np.ascontiguousarray(arr)
+        sbuf = arr.view(np.uint8).reshape(n, arr.dtype.itemsize)
+        embedded, lengths = _nul_scan(sbuf)
+        if embedded:
+            return hash_tokens(arr.tolist(), n_features, seed)
         if lib is None:  # no compiler: per-token fallback
             for i, tok in enumerate(arr.tolist()):
                 h = murmur3_32(tok, seed)
                 idx[i] = abs(h) % n_features
                 sign[i] = 1 if h >= 0 else -1
             return idx, sign
-        buf = arr.view(np.uint8).reshape(n, arr.dtype.itemsize)
+        buf = sbuf
 
-    # token length = offset of the first NUL (fixed-width pad byte)
-    nz = buf != 0
-    lengths = np.where(
-        nz.all(axis=1), buf.shape[1], nz.argmin(axis=1)
-    ).astype(np.int64)
     lib.hash_tokens_strided(
         ctypes.c_void_p(buf.ctypes.data),
         buf.shape[1],
@@ -254,11 +278,21 @@ class FeatureHasher:
             indptr = np.asarray([0, len(tokens)], dtype=np.int64)
         else:
             indptr = np.asarray(indptr, dtype=np.int64)
-            if indptr.ndim != 1 or indptr[0] != 0 or indptr[-1] != len(tokens):
+            if indptr.ndim != 1 or indptr.size == 0 or indptr[0] != 0 \
+                    or indptr[-1] != len(tokens):
                 raise ValueError(
                     f"indptr must be 1-D with indptr[0]=0 and "
                     f"indptr[-1]=len(tokens)={len(tokens)}"
                 )
+            if np.any(np.diff(indptr) < 0):
+                # a non-monotone indptr would otherwise surface as an opaque
+                # scipy internal error (or a silently malformed CSR)
+                raise ValueError("indptr must be non-decreasing")
+        if values is not None and len(values) != len(tokens):
+            raise ValueError(
+                f"values has length {len(values)} but there are "
+                f"{len(tokens)} tokens"
+            )
         return self._build_csr(tokens, indptr, values)
 
     def _build_csr(self, tokens, indptr, values) -> sp.csr_array:

@@ -70,6 +70,9 @@ def save_model(est, path: str, *, include_matrix: bool = False) -> None:
             "n_components": est.n_components_,
             "n_features": est.n_features_in_,
             "seed": est.seed_,
+            # execution-path choice is part of the numeric contract: the
+            # MXU path is f32-grade vs the scatter path's exactness
+            "use_mxu": est.use_mxu,
         }
     if include_matrix and hasattr(est, "spec_"):
         import scipy.sparse as sp
@@ -114,7 +117,7 @@ def load_model(path: str, *, backend: Optional[str] = None):
     if "countsketch" in payload:
         d = payload["countsketch"]
         est = cls(d["n_components"], random_state=d["seed"],
-                  backend=backend or "auto")
+                  backend=backend or "auto", use_mxu=d.get("use_mxu"))
         est.fit_schema(1, d["n_features"])
         return est
 

@@ -29,6 +29,8 @@ from typing import Callable, Iterator, Optional, Tuple
 import numpy as np
 import scipy.sparse as sp
 
+from randomprojection_tpu.utils.observability import annotate
+
 __all__ = [
     "RowBatchSource",
     "ArraySource",
@@ -230,7 +232,8 @@ def stream_transform(
     def materialize(entry):
         start_row, n_rows, y, in_nbytes = entry
         if not sp.issparse(y):  # forces device→host for lazy handles
-            y = np.asarray(y)
+            with annotate("rp:stream/fetch_d2h"):
+                y = np.asarray(y)
             if out_dtype is not None:
                 y = y.astype(out_dtype, copy=False)
         return start_row, n_rows, y, in_nbytes
@@ -254,7 +257,8 @@ def stream_transform(
     for start_row, batch in source.iter_batches(cursor.rows_done):
         # _transform_async is each estimator's own (possibly overridden)
         # transform, returning a lazy device handle where supported
-        y = estimator._transform_async(batch)
+        with annotate("rp:stream/dispatch"):
+            y = estimator._transform_async(batch)
         # keep only the byte count: retaining the batch itself would pin
         # pipeline_depth extra input batches of host memory
         pending.append((start_row, batch.shape[0], y, getattr(batch, "nbytes", 0)))
@@ -308,6 +312,21 @@ def stream_to_memmap(
             raise ValueError(
                 f"{out_path} has {out.shape[0]} rows but the source has "
                 f"{source.n_rows}; it belongs to a different run"
+            )
+        # a same-rows file written by a DIFFERENT estimator would silently
+        # mix two projections; width/dtype are the library-level fingerprint
+        # (the CLI's sidecar covers the full parameter set for CLI users)
+        want_width = estimator._stream_out_width()
+        want_dtype = estimator._stream_out_dtype()
+        if out.ndim != 2 or out.shape[1] != want_width or (
+            want_dtype is not None and out.dtype != np.dtype(want_dtype)
+        ):
+            raise ValueError(
+                f"{out_path} has shape {out.shape} dtype {out.dtype} but this "
+                f"estimator streams ({source.n_rows}, {want_width}) "
+                f"{want_dtype if want_dtype is not None else out.dtype}; "
+                f"resuming would mix two projections — delete the checkpoint "
+                f"and output to restart"
             )
     for lo, y in stream_transform(
         estimator, source, checkpoint_path=checkpoint_path,

@@ -90,10 +90,15 @@ def profile_trace(log_dir: Optional[str]):
 
 
 def annotate(name: str):
-    """Named region visible in profiler timelines (no-op without jax)."""
-    try:
-        import jax
+    """Named region visible in profiler timelines.
 
-        return jax.profiler.TraceAnnotation(name)
-    except ImportError:  # pragma: no cover
+    No-op unless jax is already imported: profiler stages only exist on the
+    jax path, and the numpy-only path must never pull jax in (the
+    ``backend='numpy'`` no-jax invariant, ``backends/jax_backend.py``).
+    """
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
         return contextlib.nullcontext()
+    return jax.profiler.TraceAnnotation(name)

@@ -111,3 +111,39 @@ def test_feature_hasher_feeds_countsketch():
     G_est = Y @ Y.T
     scale = np.abs(G_true).max()
     assert np.abs(G_est - G_true).max() / scale < 0.5
+
+
+def test_transform_tokens_rejects_bad_indptr():
+    """Non-monotone indptr must fail loudly, not as an opaque scipy internal
+    error or a silently malformed CSR (ADVICE r2)."""
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+
+    fh = FeatureHasher(n_features=64, input_type="string")
+    toks = np.asarray(["a", "b", "c", "d"])
+    with pytest.raises(ValueError, match="non-decreasing"):
+        fh.transform_tokens(toks, indptr=[0, 3, 1, 4])
+    with pytest.raises(ValueError, match="indptr"):
+        fh.transform_tokens(toks, indptr=[1, 4])
+    with pytest.raises(ValueError, match="values"):
+        fh.transform_tokens(toks, indptr=[0, 4], values=[1.0, 2.0])
+
+
+def test_embedded_nul_tokens_hash_consistently():
+    """A token with an embedded NUL must hash identically whether it arrives
+    as a numpy U/S array or a plain list (ADVICE r2: the strided path used
+    to truncate at the first NUL while the list path hashed all bytes)."""
+    from randomprojection_tpu.ops.hashing import hash_tokens
+
+    tok_s = b"ab\x00cd"
+    tok_u = "ab\x00cd"
+    for arr, ref in (
+        (np.asarray([tok_s, b"plain"]), [tok_s, b"plain"]),
+        (np.asarray([tok_u, "plain"]), [tok_u, "plain"]),
+    ):
+        idx_a, sign_a = hash_tokens(arr, 1 << 16)
+        idx_l, sign_l = hash_tokens(ref, 1 << 16)
+        np.testing.assert_array_equal(idx_a, idx_l)
+        np.testing.assert_array_equal(sign_a, sign_l)
+    # and an embedded-NUL token is NOT the same as its truncation
+    (i1, _), (i2, _) = hash_tokens([tok_s], 1 << 16), hash_tokens([b"ab"], 1 << 16)
+    assert i1[0] != i2[0]

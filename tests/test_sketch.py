@@ -177,3 +177,50 @@ def test_countsketch_validation():
         cs.transform(np.ones((2, 5)))
     with pytest.raises(ValueError, match="components"):
         cs.inverse_transform(np.ones((2, 5)))
+
+
+def test_countsketch_use_mxu_opt_out():
+    """use_mxu=False forces the scatter path regardless of mask size (the
+    exact-reproducibility opt-out, ADVICE r2); use_mxu=True above the mask
+    cap refuses instead of silently scattering."""
+    X = np.random.default_rng(0).normal(size=(20, 300)).astype(np.float32)
+    Ys = CountSketch(
+        16, random_state=0, backend="jax", use_mxu=False
+    ).fit(X).transform(X)
+    Yn = CountSketch(16, random_state=0, backend="numpy").fit(X).transform(X)
+    # scatter path: same accumulation structure as the host scatter —
+    # f32-rounding-tight agreement (same tolerance as the cap-fallback test)
+    np.testing.assert_allclose(Ys, Yn, rtol=2e-5, atol=2e-5)
+
+    big = CountSketch(16, random_state=0, backend="jax", use_mxu=True)
+    big._MXU_MASK_BYTES_CAP = 1024
+    big.fit(X)
+    with pytest.raises(ValueError, match="use_mxu=True"):
+        big.transform(X)
+
+    # clone-compat: the new kwarg participates in get_params
+    assert CountSketch(16, use_mxu=False).get_params()["use_mxu"] is False
+
+
+def test_countsketch_use_mxu_refuses_host_fallbacks():
+    """use_mxu=True must refuse every input that would silently take a
+    host path (f64, sparse) and set_params(use_mxu=...) must invalidate
+    the cached device fn."""
+    X = np.random.default_rng(0).normal(size=(20, 300)).astype(np.float32)
+    cs = CountSketch(16, random_state=0, backend="jax", use_mxu=True).fit(X)
+    with pytest.raises(ValueError, match="float64"):
+        cs.transform(X.astype(np.float64))
+    with pytest.raises(ValueError, match="sparse"):
+        cs.transform(sp.csr_array(X))
+    with pytest.raises(ValueError, match="requires the jax backend"):
+        CountSketch(16, random_state=0, backend="numpy", use_mxu=True).fit(X)
+
+    # set_params toggling the path drops the cached fn and takes effect
+    auto = CountSketch(16, random_state=0, backend="jax").fit(X)
+    auto.transform(X)
+    assert hasattr(auto, "_jax_fn")
+    auto.set_params(use_mxu=False)
+    assert not hasattr(auto, "_jax_fn")
+    Ys = auto.transform(X)
+    Yn = CountSketch(16, random_state=0, backend="numpy").fit(X).transform(X)
+    np.testing.assert_allclose(Ys, Yn, rtol=2e-5, atol=2e-5)

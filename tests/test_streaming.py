@@ -241,3 +241,36 @@ def test_stream_countsketch(X):
     Y_ref = cs.transform(X)
     chunks = [y for _, y in cs.transform_stream(ArraySource(X, 256))]
     np.testing.assert_allclose(np.concatenate(chunks), Y_ref, rtol=1e-6)
+
+
+def test_memmap_resume_rejects_different_estimator_shape(tmp_path):
+    """Resuming into a memmap written by a different-width/dtype estimator
+    must refuse at the library level (ADVICE r2: it used to fail only as a
+    broadcast error mid-write — or silently mix projections when shapes
+    happened to match)."""
+    from randomprojection_tpu import GaussianRandomProjection
+    from randomprojection_tpu.streaming import (
+        ArraySource,
+        StreamCursor,
+        stream_to_memmap,
+    )
+
+    X = np.random.default_rng(0).normal(size=(300, 64)).astype(np.float32)
+    src = ArraySource(X, batch_rows=100)
+    out_path = str(tmp_path / "y.npy")
+    ckpt = str(tmp_path / "cur.json")
+    est16 = GaussianRandomProjection(16, random_state=0, backend="numpy").fit(X)
+    stream_to_memmap(est16, src, out_path, checkpoint_path=ckpt)
+
+    # rewind the cursor, then try to resume with a different estimator
+    StreamCursor(rows_done=100).save(ckpt)
+    est8 = GaussianRandomProjection(8, random_state=0, backend="numpy").fit(X)
+    with pytest.raises(ValueError, match="mix two projections"):
+        stream_to_memmap(est8, src, out_path, checkpoint_path=ckpt)
+
+    # same width, different dtype: also refused
+    est16_64 = GaussianRandomProjection(16, random_state=1, backend="numpy").fit(
+        X.astype(np.float64)
+    )
+    with pytest.raises(ValueError, match="mix two projections"):
+        stream_to_memmap(est16_64, src, out_path, checkpoint_path=ckpt)
