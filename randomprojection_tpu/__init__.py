@@ -28,6 +28,7 @@ _LAZY_ESTIMATORS = (
     "CountSketch",
     "pairwise_hamming",
     "pairwise_hamming_device",
+    "pairwise_hamming_sharded",
     "cosine_from_hamming",
 )
 

@@ -45,9 +45,8 @@ def _matrix_key(jax, seed: int):
     return jax.random.fold_in(jax.random.key(seed), 0x5250)
 
 
-def _pad_rows(n: int) -> int:
-    """Bucket a row count to bound jit recompiles: next power of two, ≥ 8."""
-    return max(8, 1 << (n - 1).bit_length())
+# row padding / pad-slice rules live in parallel.sharded (row_bucket /
+# slice_rows_sharded) — shared with the sketch family's mesh path
 
 
 class _LazyMask:
@@ -350,7 +349,9 @@ class JaxBackend(ProjectionBackend):
                 n = X.shape[0]
                 x = np.ascontiguousarray(X, dtype=self.compute_dtype)
 
-            pad_to = _pad_rows(n)
+            from randomprojection_tpu.parallel.sharded import row_bucket
+
+            pad_to = row_bucket(n, self.mesh, self.data_axis)
             if pad_to != n:
                 pad = ((0, pad_to - n), (0, 0))
                 x = jnp.pad(x, pad) if device_resident else np.pad(x, pad)
@@ -470,22 +471,13 @@ class JaxBackend(ProjectionBackend):
         return fn
 
     def _slice_rows(self, y, n: int):
-        """Drop pad rows.  On a mesh, eager slicing of a sharded array can
-        hit ambiguous-sharding gather rules; slice under jit with an explicit
-        row-sharded out_sharding instead (cached per row count)."""
-        if y.shape[0] == n:
-            return y
-        if self.mesh is None:
-            return y[:n]
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
+        """Drop pad rows (see ``parallel.sharded.slice_rows_sharded`` for
+        the mesh/ragged rules)."""
+        from randomprojection_tpu.parallel.sharded import slice_rows_sharded
 
-        fn = self._slice_fns.get(n)
-        if fn is None:
-            out_sh = NamedSharding(self.mesh, PartitionSpec(self.data_axis, None))
-            fn = jax.jit(lambda a: a[:n], out_shardings=out_sh)
-            self._slice_fns[n] = fn
-        return fn(y)
+        return slice_rows_sharded(
+            y, n, self.mesh, self.data_axis, cache=self._slice_fns
+        )
 
     def _transform_impl(self, X, state, spec: ProjectionSpec):
         from randomprojection_tpu.utils.observability import annotate

@@ -16,6 +16,7 @@ from randomprojection_tpu.models.sketch import (
     cosine_from_hamming,
     pairwise_hamming,
     pairwise_hamming_device,
+    pairwise_hamming_sharded,
 )
 
 __all__ = [
@@ -26,5 +27,6 @@ __all__ = [
     "CountSketch",
     "pairwise_hamming",
     "pairwise_hamming_device",
+    "pairwise_hamming_sharded",
     "cosine_from_hamming",
 ]

@@ -18,7 +18,6 @@ they compose with the streaming layer and backends like the JL estimators.
 
 from __future__ import annotations
 
-import functools
 import math
 import numbers
 from typing import Optional
@@ -38,6 +37,7 @@ __all__ = [
     "CountSketch",
     "pairwise_hamming",
     "pairwise_hamming_device",
+    "pairwise_hamming_sharded",
     "cosine_from_hamming",
 ]
 
@@ -104,18 +104,24 @@ def pairwise_hamming(A, B=None):
 _HAMMING_TILE_FN = None
 
 
+def _hamming_counts(a, b):
+    """The one device Hamming kernel: XOR + per-byte population count.
+    ``a (n1, nbytes)`` × ``b (n2, nbytes)`` uint8 → ``(n1, n2)`` int32.
+    Used by the single-device tiler and as the per-shard body of
+    ``pairwise_hamming_sharded``."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.bitwise_xor(a[:, None, :], b[None, :, :])
+    return jax.lax.population_count(x).astype(jnp.int32).sum(-1)
+
+
 def _hamming_tile_fn():
     global _HAMMING_TILE_FN
     if _HAMMING_TILE_FN is None:
         import jax
-        import jax.numpy as jnp
 
-        @jax.jit
-        def tile_fn(a, b):
-            x = jnp.bitwise_xor(a[:, None, :], b[None, :, :])
-            return jax.lax.population_count(x).astype(jnp.int32).sum(-1)
-
-        _HAMMING_TILE_FN = tile_fn
+        _HAMMING_TILE_FN = jax.jit(_hamming_counts)
     return _HAMMING_TILE_FN
 
 
@@ -126,8 +132,8 @@ def pairwise_hamming_device(A, B=None, *, tile: int = 2048):
     ``B`` is held on device whole and the dense output is allocated on the
     host, so this serves query batches against an index that fits HBM
     (n2·nbytes ≲ GBs) with n1 arbitrarily large via ``tile``.  For an index
-    beyond one chip's HBM, shard B across hosts/chips and merge the tiles —
-    this function is the per-shard primitive, not the sharding.
+    beyond one chip's HBM, use ``pairwise_hamming_sharded`` (B row-sharded
+    over a mesh); this function is its per-shard primitive.
     """
     import jax.numpy as jnp
 
@@ -140,6 +146,46 @@ def pairwise_hamming_device(A, B=None, *, tile: int = 2048):
     for lo in range(0, A.shape[0], tile):
         hi = min(lo + tile, A.shape[0])
         out[lo:hi] = np.asarray(tile_fn(jnp.asarray(A[lo:hi]), b_dev))
+    return out
+
+
+def pairwise_hamming_sharded(A, B=None, *, mesh, data_axis: str = "data",
+                             tile: int = 2048):
+    """Device Hamming with the index ``B`` row-sharded over a mesh.
+
+    The config-4 scale-out ``pairwise_hamming_device`` defers to: an index
+    too large for one chip's HBM (1B×32B codes = 32 GB) shards its rows
+    over ``data_axis`` — each device holds ``B[n2/p]`` and scores every
+    query tile against its own shard; the ``(n1, n2)`` result assembles on
+    the host with zero collectives (the output's column blocks ARE the
+    shards).  Queries ``A`` stream through in ``tile``-row chunks,
+    replicated to all devices.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    A = np.asarray(A, dtype=np.uint8)
+    B = A if B is None else np.asarray(B, dtype=np.uint8)
+    n2 = B.shape[0]
+    p = mesh.shape[data_axis]
+    pad = -n2 % p
+    b_dev = jax.device_put(
+        jnp.asarray(np.pad(B, ((0, pad), (0, 0)))),
+        NamedSharding(mesh, P(data_axis, None)),
+    )
+
+    fn = jax.jit(
+        jax.shard_map(
+            _hamming_counts, mesh=mesh,
+            in_specs=(P(), P(data_axis, None)),
+            out_specs=P(None, data_axis),
+        )
+    )
+    out = np.empty((A.shape[0], n2), dtype=np.int32)
+    for lo in range(0, A.shape[0], tile):
+        hi = min(lo + tile, A.shape[0])
+        out[lo:hi] = np.asarray(fn(jnp.asarray(A[lo:hi]), b_dev))[:, :n2]
     return out
 
 
@@ -169,7 +215,8 @@ class CountSketch(ParamsMixin):
     """
 
     def __init__(self, n_components, *, random_state=None, backend="auto",
-                 use_mxu: Optional[bool] = None):
+                 use_mxu: Optional[bool] = None, mesh=None,
+                 data_axis: str = "data"):
         if not isinstance(n_components, numbers.Integral) or n_components <= 0:
             raise ValueError(
                 f"n_components must be a positive int, got {n_components!r}"
@@ -183,6 +230,12 @@ class CountSketch(ParamsMixin):
         # agrees with numpy at f32 grade only); True = require the MXU path
         # (raises at transform if the mask would exceed the cap).
         self.use_mxu = use_mxu
+        # DP row-sharding over a jax Mesh (config 5 is a "100M docs on
+        # v5e-8" workload — BASELINE.json:11): rows shard over `data_axis`,
+        # the one-hot mask / hash maps replicate, zero collectives — the
+        # same decomposition as the JL backend's DP path.
+        self.mesh = mesh
+        self.data_axis = data_axis
 
     def fit_schema(self, n_samples: int, n_features: int, dtype=np.float64):
         if n_features <= 0:
@@ -213,10 +266,11 @@ class CountSketch(ParamsMixin):
                 f"'auto' with jax importable), got backend={self.backend!r}"
             )
         self.__dict__.pop("_jax_fn", None)
+        self.__dict__.pop("_slice_fns", None)
 
     def set_params(self, **params):
         super().set_params(**params)
-        if {"use_mxu", "backend"} & params.keys():
+        if {"use_mxu", "backend", "mesh", "data_axis"} & params.keys():
             self._resolve_execution()
         return self
 
@@ -261,7 +315,68 @@ class CountSketch(ParamsMixin):
     # space at k=256 would need 512 MB)
     _MXU_MASK_BYTES_CAP = 64 << 20
 
-    def _transform_dense_jax(self, X):
+    def _shard_wrap(self, jax, local, n_extra_args: int):
+        """jit ``local`` — under a mesh, shard_map'd with rows over
+        ``data_axis`` and every other operand replicated (DP: zero
+        collectives; each shard sketches its own rows)."""
+        if self.mesh is None:
+            return jax.jit(local)
+        from jax.sharding import PartitionSpec as P
+
+        in_specs = (P(self.data_axis, None),) + (P(),) * n_extra_args
+        return jax.jit(
+            jax.shard_map(
+                local, mesh=self.mesh, in_specs=in_specs,
+                out_specs=P(self.data_axis, None),
+            )
+        )
+
+    def _build_jax_fn(self, jax, jnp):
+        k, d = self.n_components_, self.n_features_in_
+
+        fits_cap = 2 * k * d <= self._MXU_MASK_BYTES_CAP
+        if self.use_mxu and not fits_cap:
+            raise ValueError(
+                f"use_mxu=True but the one-hot mask ({2 * k * d} bytes "
+                f"bf16) exceeds the {self._MXU_MASK_BYTES_CAP}-byte cap; "
+                "use use_mxu=None (auto) or False (scatter)"
+            )
+        if fits_cap if self.use_mxu is None else self.use_mxu:
+            # MXU path: CountSketch IS a projection with a one-hot ±1
+            # matrix M[h(j), j] = s(j) — exact in bf16, so the split2
+            # two-pass matmul gives f32-grade output.  Measured on the
+            # real chip (4096→256, f32 rows): one-hot split2 2.2M
+            # rows/s vs scatter-add 1.10M, segment_sum 1.20M, one-hot
+            # 'high' 1.40M — scatter is a slow path on TPU; the MXU
+            # wins whenever M fits comfortably in HBM.
+            from randomprojection_tpu.ops.split_matmul import split2_project
+
+            mask = (
+                jnp.zeros((k, d), jnp.float32)
+                .at[jnp.asarray(self.h_), jnp.arange(d)]
+                .set(jnp.asarray(self.s_, jnp.float32))
+                .astype(jnp.bfloat16)
+            )
+
+            def sketch_mxu(x, mask):
+                return split2_project(x, mask, 1.0).astype(x.dtype)
+
+            fn = self._shard_wrap(jax, sketch_mxu, 1)
+            self._jax_fn = lambda x: fn(x, mask)
+        else:
+
+            def sketch_scatter(x, h, s):
+                signed = x * s
+                # scatter-add over features: Y[:, h[j]] += x̃[:, j]
+                y = jnp.zeros((x.shape[0], k), dtype=x.dtype)
+                return y.at[:, h].add(signed)
+
+            fn = self._shard_wrap(jax, sketch_scatter, 2)
+            h_dev = jnp.asarray(self.h_)
+            s_dev = jnp.asarray(self.s_, jnp.float32)
+            self._jax_fn = lambda x: fn(x, h_dev, s_dev.astype(x.dtype))
+
+    def _transform_dense_jax(self, X, *, materialize: bool = True):
         if X.dtype == np.float64:
             # jax (x64 disabled) would silently truncate to f32, breaking
             # the documented numpy/jax agreement; f64 stays on host
@@ -274,54 +389,25 @@ class CountSketch(ParamsMixin):
         import jax
         import jax.numpy as jnp
 
+        from randomprojection_tpu.parallel.sharded import (
+            row_bucket,
+            slice_rows_sharded,
+        )
+
         if not hasattr(self, "_jax_fn"):
-            k, d = self.n_components_, self.n_features_in_
-
-            fits_cap = 2 * k * d <= self._MXU_MASK_BYTES_CAP
-            if self.use_mxu and not fits_cap:
-                raise ValueError(
-                    f"use_mxu=True but the one-hot mask ({2 * k * d} bytes "
-                    f"bf16) exceeds the {self._MXU_MASK_BYTES_CAP}-byte cap; "
-                    "use use_mxu=None (auto) or False (scatter)"
-                )
-            if fits_cap if self.use_mxu is None else self.use_mxu:
-                # MXU path: CountSketch IS a projection with a one-hot ±1
-                # matrix M[h(j), j] = s(j) — exact in bf16, so the split2
-                # two-pass matmul gives f32-grade output.  Measured on the
-                # real chip (4096→256, f32 rows): one-hot split2 2.2M
-                # rows/s vs scatter-add 1.10M, segment_sum 1.20M, one-hot
-                # 'high' 1.40M — scatter is a slow path on TPU; the MXU
-                # wins whenever M fits comfortably in HBM.
-                from randomprojection_tpu.ops.split_matmul import (
-                    split2_project,
-                )
-
-                mask = (
-                    jnp.zeros((k, d), jnp.float32)
-                    .at[jnp.asarray(self.h_), jnp.arange(d)]
-                    .set(jnp.asarray(self.s_, jnp.float32))
-                    .astype(jnp.bfloat16)
-                )
-
-                @jax.jit
-                def sketch_mxu(x, mask):
-                    return split2_project(x, mask, 1.0).astype(x.dtype)
-
-                self._jax_fn = functools.partial(sketch_mxu, mask=mask)
-            else:
-
-                @jax.jit
-                def sketch_scatter(x, h, s):
-                    signed = x * s
-                    # scatter-add over features: Y[:, h[j]] += x̃[:, j]
-                    y = jnp.zeros((x.shape[0], k), dtype=x.dtype)
-                    return y.at[:, h].add(signed)
-
-                self._jax_fn = lambda x: sketch_scatter(
-                    x, jnp.asarray(self.h_), jnp.asarray(self.s_, x.dtype)
-                )
-        y = self._jax_fn(jnp.asarray(X))
-        return np.asarray(y)
+            self._build_jax_fn(jax, jnp)
+        n = X.shape[0]
+        x = jnp.asarray(X)
+        pad_to = row_bucket(n, self.mesh, self.data_axis)
+        if pad_to != n:
+            x = jnp.pad(x, ((0, pad_to - n), (0, 0)))
+        y = slice_rows_sharded(
+            self._jax_fn(x), n, self.mesh, self.data_axis,
+            cache=self.__dict__.setdefault("_slice_fns", {}),
+        )
+        if materialize:
+            return np.asarray(y)
+        return y  # lazy device handle: the stream pipeline fetches later
 
     def _transform_csr(self, X):
         if X.shape[1] != self.n_features_in_:
@@ -352,7 +438,19 @@ class CountSketch(ParamsMixin):
         return stream_transform(self, source, **kwargs)
 
     def _transform_async(self, X):
-        return self.transform(X)  # host scatter paths are synchronous
+        """Streaming transform: returns a lazy device handle on the jax
+        dense-f32 path so the pipeline overlaps sketch batches (the host
+        paths — f64, sparse, numpy backend — stay synchronous)."""
+        self._check_is_fitted()
+        if not sp.issparse(X):
+            X = check_array(X, accept_sparse=False)
+            if X.shape[1] != self.n_features_in_:
+                raise ValueError(
+                    f"X has {X.shape[1]} features, expected {self.n_features_in_}"
+                )
+            if self._use_jax and X.dtype != np.float64:
+                return self._transform_dense_jax(X, materialize=False)
+        return self.transform(X)
 
     def _stream_out_dtype(self):
         return None  # keep whatever dtype transform produced

@@ -28,9 +28,21 @@ lineage recomputation but with explicit cursors.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 __all__ = ["initialize", "is_multi_process", "host_row_range"]
+
+#: env vars whose presence means "this process was launched as part of a
+#: distributed job" — if auto-detection then fails, that is a
+#: misconfiguration to surface, not a single-machine run to degrade to
+_DISTRIBUTED_ENV_MARKERS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+    "JAX_PROCESS_ID",
+)
 
 
 def initialize(
@@ -43,6 +55,13 @@ def initialize(
     With no arguments, relies on the TPU environment's auto-detection
     (GKE/TPU-VM metadata).  Explicit arguments support manual bring-up.
     Idempotent: repeated calls after a successful initialize are ignored.
+
+    Failure policy: initialization errors are swallowed ONLY when nothing
+    indicates a distributed launch (no explicit arguments, no coordinator
+    env vars) — that is the ordinary single-machine case.  Any explicit
+    argument, or a distributed-launch env marker, makes failure fatal:
+    silently degrading a real pod job to single-process would compute 1/Nth
+    of the work while claiming success.
     """
     import jax
 
@@ -56,10 +75,18 @@ def initialize(
         )
         initialize._done = True
     except (ValueError, RuntimeError) as e:
-        # single-process environment (no coordinator configured): fine —
-        # jax.devices() already covers the local chips
-        if num_processes not in (None, 1):
-            raise
+        explicit = (
+            coordinator_address is not None
+            or process_id is not None
+            or num_processes not in (None, 1)
+        )
+        markers = [v for v in _DISTRIBUTED_ENV_MARKERS if os.environ.get(v)]
+        if explicit or markers:
+            raise RuntimeError(
+                "jax.distributed.initialize failed for what looks like a "
+                f"distributed launch (explicit args={explicit}, env markers="
+                f"{markers}); refusing to silently degrade to single-process"
+            ) from e
         initialize._done = True
         import logging
 
@@ -74,18 +101,39 @@ def is_multi_process() -> bool:
     return jax.process_count() > 1
 
 
-def host_row_range(n_rows: int) -> Tuple[int, int]:
+def host_row_range(
+    n_rows: int,
+    *,
+    process_id: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Tuple[int, int]:
     """This host's contiguous row slice ``[lo, hi)`` of a global stream.
 
     Rows are independent in X·Rᵀ, so the natural multi-host decomposition
     is block-by-process (the Spark partition map's equivalent).  The split
     is balanced to within one row and every process computes it without
-    communication.
+    communication.  ``process_id``/``process_count`` default to the live
+    runtime's values; passing them makes the function pure (tests, offline
+    planning).
     """
-    import jax
+    if n_rows < 0:
+        raise ValueError(f"n_rows must be non-negative, got {n_rows}")
+    if (process_id is None) != (process_count is None):
+        # a half-specified pair silently overridden by the live runtime
+        # would return a wrong partition plan with no error
+        raise ValueError(
+            "pass process_id and process_count together (or neither, to "
+            "use the live runtime's values)"
+        )
+    if process_id is None:
+        import jax
 
-    p, n_p = jax.process_index(), jax.process_count()
-    base, extra = divmod(n_rows, n_p)
-    lo = p * base + min(p, extra)
-    hi = lo + base + (1 if p < extra else 0)
+        process_id, process_count = jax.process_index(), jax.process_count()
+    if not 0 <= process_id < process_count:
+        raise ValueError(
+            f"process_id {process_id} out of range for {process_count} processes"
+        )
+    base, extra = divmod(n_rows, process_count)
+    lo = process_id * base + min(process_id, extra)
+    hi = lo + base + (1 if process_id < extra else 0)
     return lo, hi

