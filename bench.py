@@ -37,6 +37,9 @@ Method
   reference is dense f32 BLAS on this host measured in the same run (the
   honest CPU number per SURVEY.md §7 — the reference's own sparse CSR path
   is orders slower).
+- On THIS box the believable numbers are dominated by ~133 ms/dispatch
+  virtualization overhead and are lower bounds on chip throughput — see
+  BASELINE.md "What this box's believable numbers actually measure".
 
 Implementation lives in ``randomprojection_tpu/benchmark.py`` (presets,
 reusable from the CLI); this wrapper keeps the driver's entry point stable.
