@@ -8,25 +8,36 @@ estimates from collision rates.
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
-
-sys.path.insert(0, ".")
-from randomprojection_tpu import (
-    SignRandomProjection,
-    cosine_from_hamming,
-    pairwise_hamming_device,
-)
-from randomprojection_tpu.streaming import CallableSource
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", choices=["small", "full"], default="small")
     ap.add_argument("--backend", default="jax")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="force a virtual CPU mesh of this many devices")
     args = ap.parse_args()
+
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, ".")
+    from randomprojection_tpu import (
+        SignRandomProjection,
+        cosine_from_hamming,
+        pairwise_hamming_device,
+    )
+    from randomprojection_tpu.streaming import CallableSource
     # full-scale config is 1e9 rows; this example streams what you give it
     n = 2_000_000 if args.scale == "full" else 50_000
     d, bits, batch = 768, 256, 65_536
@@ -47,8 +58,18 @@ def main():
     dt = time.perf_counter() - t0
     assert codes.dtype == np.uint8 and codes.shape == (n, bits // 8)
 
-    # query the code index: top-5 neighbors of the first 4 rows
-    H = pairwise_hamming_device(codes[:4], codes)
+    # query the code index: top-5 neighbors of the first 4 rows.  With more
+    # than one device, shard the index rows across the mesh — the scale-out
+    # for indexes beyond one chip's HBM (1B×32B codes = 32 GB)
+    import jax
+
+    if len(jax.devices()) > 1:
+        from randomprojection_tpu import pairwise_hamming_sharded
+        from randomprojection_tpu.parallel import default_mesh
+
+        H = pairwise_hamming_sharded(codes[:4], codes, mesh=default_mesh())
+    else:
+        H = pairwise_hamming_device(codes[:4], codes)
     nn = np.argsort(H, axis=1)[:, 1:6]
     est_cos = cosine_from_hamming(np.take_along_axis(H, nn, axis=1), bits)
     print(json.dumps({

@@ -9,14 +9,11 @@ core (the hasher is the native batch kernel in native/murmur3.cpp).
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
-
-sys.path.insert(0, ".")
-from randomprojection_tpu import CountSketch
-from randomprojection_tpu.ops.hashing import FeatureHasher
 
 
 def synth_docs(lo, hi, vocab=50_000):
@@ -49,7 +46,22 @@ def main():
         help="'tokens' = vectorized transform_tokens path (C++ batch "
         "murmur3, no per-token Python); 'dict' = the per-sample dict API",
     )
+    ap.add_argument("--devices", type=int, default=None,
+                    help="force a virtual CPU mesh of this many devices")
     args = ap.parse_args()
+
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, ".")
+    from randomprojection_tpu import CountSketch
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+
     n_docs = 200_000 if args.scale == "full" else 10_000
     hash_dim, k, batch = 2**18, 256, 2000
 
@@ -80,6 +92,24 @@ def main():
     }
     if tokens_seen:
         out["tokens_per_s"] = round(tokens_seen / dt, 1)
+
+    # On a multi-chip slice the DENSE sketch path (the MXU one-hot matmul)
+    # DP-shards rows over the mesh — the "100M docs on v5e-8" deployment
+    # shape.  (The CSR ingest above is the host scatter path either way.)
+    import jax
+
+    if len(jax.devices()) > 1:
+        from randomprojection_tpu.parallel import default_mesh
+
+        dn, dd = 8192, 4096
+        Xd = np.random.default_rng(0).standard_normal((dn, dd), np.float32)
+        csd = CountSketch(k, random_state=0, mesh=default_mesh())
+        csd.fit_schema(dn, dd)
+        csd.transform(Xd)  # warm the full-size program (row buckets by n)
+        td = time.perf_counter()
+        csd.transform(Xd)
+        out["dense_mesh_rows_per_s"] = round(dn / (time.perf_counter() - td), 1)
+        out["mesh_devices"] = len(jax.devices())
     print(json.dumps(out))
 
 

@@ -1,0 +1,93 @@
+"""Multi-host streamed projection (SURVEY.md §3.4 process model).
+
+One process per host, every process running THIS script unchanged.  The
+Spark driver/executor pattern maps to SPMD: `distributed.initialize()`
+joins the processes into one runtime, `host_row_range` gives each host its
+own contiguous slice of the global stream (rows are independent in X·Rᵀ,
+so no cross-host coordination is needed), and the counter-based PRNG makes
+every host materialize the identical projection matrix from the seed.
+
+Single process (a laptop, or one TPU VM):
+
+    python examples/multihost.py
+
+Manual two-process bring-up on one machine (what tests/test_distributed.py
+automates; JAX_PLATFORMS=cpu so both processes are plain CPU hosts):
+
+    JAX_PLATFORMS=cpu python examples/multihost.py \
+        --coordinator localhost:8476 --num-processes 2 --process-id 0 &
+    JAX_PLATFORMS=cpu python examples/multihost.py \
+        --coordinator localhost:8476 --num-processes 2 --process-id 1
+
+On a real TPU pod (GKE / TPU VM), omit the flags: `initialize()` uses the
+environment's auto-detection, and each host drives its local chips.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", default=None,
+                    help="coordinator address host:port (process 0 hosts it)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--d", type=int, default=1024)
+    ap.add_argument("--k", type=int, default=128)
+    args = ap.parse_args()
+
+    sys.path.insert(0, ".")
+    from randomprojection_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    import jax
+
+    from randomprojection_tpu import SparseRandomProjection
+    from randomprojection_tpu.streaming import CallableSource
+
+    # this host's slice of the global row range — no communication needed
+    lo, hi = distributed.host_row_range(args.rows)
+
+    # the global source is any seekable range-reader; the local source maps
+    # this host's [0, hi-lo) offsets onto GLOBAL rows [lo, hi) — the data a
+    # row contains must depend on its global index, not which host reads it
+    def read(a, b):
+        return np.random.default_rng(lo + a).standard_normal(
+            (b - a, args.d), dtype=np.float32
+        )
+
+    src = CallableSource(read, n_rows=hi - lo, n_features=args.d,
+                         batch_rows=16384)
+
+    # fit from schema: same (seed, k, d) on every host => identical matrix
+    rp = SparseRandomProjection(
+        args.k, density=1 / 3, random_state=0, backend="jax"
+    ).fit_schema(args.rows, args.d, np.float32)
+
+    t0 = time.perf_counter()
+    done = 0
+    for start, y in rp.transform_stream(src):
+        done += y.shape[0]
+    dt = time.perf_counter() - t0
+
+    print(json.dumps({
+        "process": jax.process_index(),
+        "process_count": jax.process_count(),
+        "row_range": [lo, hi],
+        "rows_done": done,
+        "rows_per_s": round(done / dt, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

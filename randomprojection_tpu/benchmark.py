@@ -129,6 +129,22 @@ def select_headline(results: dict, budget: float = DISTORTION_BUDGET) -> str:
     return max(eligible, key=lambda n: results[n]["rows_per_s"])
 
 
+def detect_pass_invariance(results: dict, mxu_passes: dict) -> bool:
+    """Virtualization tripwire (BASELINE.md round-3 finding): modes that
+    execute 1× vs 2-3× the MXU work must not record near-identical elapsed
+    times — if they do, the measured quantity is dispatch overhead or a
+    call cache, not the arithmetic.  Informational: does not change the
+    headline, but flags the whole run for the reader."""
+    els = [results[n]["elapsed_s"] for n in results]
+    passes = [mxu_passes[n] for n in results]
+    return bool(
+        len(els) >= 2
+        and max(passes) >= 2 * min(passes)
+        and max(els) > 0
+        and (max(els) - min(els)) / max(els) < 0.15
+    )
+
+
 def measure_distortion(jax, jnp, R_f32, x_cpu, name, scale, **mode_kw):
     """Max relative pairwise-distance error vs CPU f64, same R."""
     project, in_dtype, r_prep = _mode_project_fn(jax, jnp, name, scale,
@@ -404,6 +420,8 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
     headline = select_headline(results)
     head = results[headline]
 
+    elapsed_pass_invariant = detect_pass_invariance(results, mxu_passes)
+
     # CPU reference: dense f32 BLAS on this host, same shapes
     r_cpu = np.asarray(R, dtype=np.float32)
     x_cpu @ r_cpu.T  # warm BLAS
@@ -433,6 +451,7 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
         "rows_timed": head["rows_timed"],
         "implied_tflops": head["implied_tflops"],
         "timing_suspect": head["timing_suspect"],
+        "elapsed_pass_invariant": elapsed_pass_invariant,
         "checksum": head["checksum"],
         # per-config tracked numbers (BASELINE.json:9-11) so every workload
         # has a recorded throughput; config3 needs the TPU-only lazy kernel

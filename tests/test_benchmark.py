@@ -52,3 +52,21 @@ def test_none_in_budget_picks_most_accurate_non_suspect():
 
 def test_budget_constant_matches_contract():
     assert DISTORTION_BUDGET == 1e-3
+
+
+def test_pass_invariance_tripwire():
+    """Near-identical elapsed across modes with different MXU pass counts
+    flags the run as dispatch/cache-bound (BASELINE.md round-3 finding)."""
+    from randomprojection_tpu.benchmark import detect_pass_invariance
+
+    passes = {"a": 1, "b": 2, "c": 3}
+
+    def res(*els):
+        return {n: {"elapsed_s": e} for n, e in zip(("a", "b", "c"), els)}
+
+    # uniform elapsed despite 1x/2x/3x work: flagged
+    assert detect_pass_invariance(res(0.40, 0.41, 0.39), passes)
+    # elapsed tracks pass count: healthy
+    assert not detect_pass_invariance(res(0.20, 0.40, 0.60), passes)
+    # same pass count everywhere: invariance is expected, not suspicious
+    assert not detect_pass_invariance(res(0.40, 0.41), {"a": 2, "b": 2})
