@@ -18,7 +18,6 @@ they compose with the streaming layer and backends like the JL estimators.
 
 from __future__ import annotations
 
-import math
 import numbers
 from typing import Optional
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 import ctypes
 import numbers
 import struct
-from typing import Iterable, Optional
+from typing import Iterable
 
 import numpy as np
 import scipy.sparse as sp
