@@ -29,7 +29,8 @@ def _compile() -> bool:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_SO))
         os.close(fd)
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+             _SRC, "-o", tmp],
             check=True,
             capture_output=True,
             timeout=120,

@@ -11,8 +11,12 @@
 //   idx  = |h| mod n_features
 //   sign = +1 if h >= 0 else -1        (alternate_sign)
 
+#include <algorithm>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 static inline uint32_t rotl32(uint32_t x, int8_t r) {
   return (x << r) | (x >> (32 - r));
@@ -25,6 +29,49 @@ static inline uint32_t fmix32(uint32_t h) {
   h *= 0xc2b2ae35u;
   h ^= h >> 16;
   return h;
+}
+
+// Threading: token i's outputs depend only on token i, so splitting the
+// range over threads is bit-identical to the serial loop at any thread
+// count.  Engages only for large batches (>= 2^18 tokens) on multi-core
+// hosts; RP_HASH_THREADS caps or disables (0/1 = serial).  The dev box for
+// this repo has one core — real ingest hosts (config 5: 100M docs) don't.
+static int64_t hash_worker_count(int64_t n_tokens) {
+  int64_t hc = static_cast<int64_t>(std::thread::hardware_concurrency());
+  if (const char* env = std::getenv("RP_HASH_THREADS")) {
+    const long v = std::strtol(env, nullptr, 10);
+    hc = v > 0 ? v : 1;
+  }
+  if (hc <= 1 || n_tokens < (int64_t{1} << 18)) return 1;
+  // keep >= 64k tokens per thread so spawn cost stays negligible
+  return std::max<int64_t>(1, std::min(hc, n_tokens >> 16));
+}
+
+template <typename Fn>
+static void parallel_over(int64_t n, Fn fn) {
+  const int64_t nw = hash_worker_count(n);
+  if (nw == 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nw);
+  const int64_t chunk = (n + nw - 1) / nw;
+  int64_t dispatched = 0;  // rows [0, dispatched) are owned by threads
+  // spawn failure (e.g. EAGAIN under RLIMIT_NPROC) must not escape the
+  // extern "C" boundary into ctypes: finish the rest serially instead
+  try {
+    for (int64_t w = 0; w < nw; w++) {
+      const int64_t lo = w * chunk;
+      const int64_t hi = std::min(n, lo + chunk);
+      if (lo >= hi) break;
+      threads.emplace_back(fn, lo, hi);
+      dispatched = hi;
+    }
+  } catch (...) {
+  }
+  if (dispatched < n) fn(dispatched, n);
+  for (auto& t : threads) t.join();
 }
 
 extern "C" {
@@ -68,14 +115,16 @@ uint32_t murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
 void hash_tokens(const uint8_t* buf, const int64_t* offsets, int64_t n_tokens,
                  uint32_t seed, uint32_t n_features, int32_t* out_idx,
                  int8_t* out_sign) {
-  for (int64_t i = 0; i < n_tokens; i++) {
-    const int64_t lo = offsets[i];
-    const int64_t len = offsets[i + 1] - lo;
-    const int32_t h = static_cast<int32_t>(murmur3_32(buf + lo, len, seed));
-    const int64_t habs = h < 0 ? -static_cast<int64_t>(h) : h;
-    out_idx[i] = static_cast<int32_t>(habs % n_features);
-    out_sign[i] = h >= 0 ? 1 : -1;
-  }
+  parallel_over(n_tokens, [=](int64_t a, int64_t b) {
+    for (int64_t i = a; i < b; i++) {
+      const int64_t lo = offsets[i];
+      const int64_t len = offsets[i + 1] - lo;
+      const int32_t h = static_cast<int32_t>(murmur3_32(buf + lo, len, seed));
+      const int64_t habs = h < 0 ? -static_cast<int64_t>(h) : h;
+      out_idx[i] = static_cast<int32_t>(habs % n_features);
+      out_sign[i] = h >= 0 ? 1 : -1;
+    }
+  });
 }
 
 // Strided batch: token i = buf[i*stride, i*stride + lengths[i]).  This is
@@ -86,13 +135,15 @@ void hash_tokens_strided(const uint8_t* buf, int64_t stride,
                          const int64_t* lengths, int64_t n_tokens,
                          uint32_t seed, uint32_t n_features,
                          int32_t* out_idx, int8_t* out_sign) {
-  for (int64_t i = 0; i < n_tokens; i++) {
-    const int32_t h = static_cast<int32_t>(
-        murmur3_32(buf + i * stride, lengths[i], seed));
-    const int64_t habs = h < 0 ? -static_cast<int64_t>(h) : h;
-    out_idx[i] = static_cast<int32_t>(habs % n_features);
-    out_sign[i] = h >= 0 ? 1 : -1;
-  }
+  parallel_over(n_tokens, [=](int64_t a, int64_t b) {
+    for (int64_t i = a; i < b; i++) {
+      const int32_t h = static_cast<int32_t>(
+          murmur3_32(buf + i * stride, lengths[i], seed));
+      const int64_t habs = h < 0 ? -static_cast<int64_t>(h) : h;
+      out_idx[i] = static_cast<int32_t>(habs % n_features);
+      out_sign[i] = h >= 0 ? 1 : -1;
+    }
+  });
 }
 
 }  // extern "C"

@@ -147,3 +147,27 @@ def test_embedded_nul_tokens_hash_consistently():
     # and an embedded-NUL token is NOT the same as its truncation
     (i1, _), (i2, _) = hash_tokens([tok_s], 1 << 16), hash_tokens([b"ab"], 1 << 16)
     assert i1[0] != i2[0]
+
+
+def test_threaded_hashing_bit_identical(monkeypatch):
+    """Token i's outputs depend only on token i, so the threaded batch path
+    must be bit-identical to serial at any thread count (RP_HASH_THREADS
+    forces threads even on a 1-core box; batch >= 2^18 engages the split)."""
+    from randomprojection_tpu.native.build import load_murmur3
+    from randomprojection_tpu.ops.hashing import hash_tokens
+
+    if load_murmur3() is None:
+        pytest.skip("no compiler: threaded path does not exist")
+    rng = np.random.default_rng(0)
+    toks = np.char.add("w", rng.integers(0, 1 << 20, size=(1 << 18) + 3).astype("U8"))
+    monkeypatch.setenv("RP_HASH_THREADS", "1")
+    idx1, sign1 = hash_tokens(toks, 1 << 16)
+    monkeypatch.setenv("RP_HASH_THREADS", "4")
+    idx4, sign4 = hash_tokens(toks, 1 << 16)
+    np.testing.assert_array_equal(idx1, idx4)
+    np.testing.assert_array_equal(sign1, sign4)
+    # list path (offsets-based hash_tokens) too
+    sub = toks[: (1 << 18) + 3].tolist()
+    monkeypatch.setenv("RP_HASH_THREADS", "3")
+    idxl, _ = hash_tokens(sub, 1 << 16)
+    np.testing.assert_array_equal(idxl, idx1)
