@@ -1,0 +1,119 @@
+"""Regenerate docs/API.md from the live package surface.
+
+Run from the repo root: ``python docs/gen_api.py``.  Keeps the API doc in
+lock-step with code — the doc is generated, never hand-edited.
+"""
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import randomprojection_tpu as rp  # noqa: E402
+
+# RP_API_OUT overrides the output path (used by the staleness test)
+OUT = os.environ.get("RP_API_OUT") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "API.md"
+)
+
+
+def sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def first_line(obj):
+    d = inspect.getdoc(obj) or ""
+    return d.splitlines()[0] if d else ""
+
+
+def main():
+    lines = [
+        "# API reference", "",
+        "Public surface of `randomprojection_tpu` (generated from the live",
+        "package; regenerate with `python docs/gen_api.py` after surface",
+        "changes).", "",
+        "## Top level (`import randomprojection_tpu as rp`)", "",
+    ]
+    for name in sorted(rp.__all__):
+        obj = getattr(rp, name)
+        if inspect.isclass(obj):
+            init_sig = (
+                sig(obj.__init__).replace("(self, ", "(").replace("(self)", "()")
+            )
+            lines += [f"### `{name}{init_sig}`", "", first_line(obj), ""]
+            methods = [
+                m for m in (
+                    "fit", "fit_schema", "fit_source", "transform",
+                    "fit_transform", "transform_stream", "inverse_transform",
+                    "get_feature_names_out", "get_params", "set_params",
+                    "components_as_numpy",
+                )
+                if callable(getattr(obj, m, None))
+            ]
+            if methods:
+                lines += ["Methods: " + ", ".join(f"`{m}`" for m in methods), ""]
+        elif callable(obj):
+            lines += [f"### `{name}{sig(obj)}`", "", first_line(obj), ""]
+        else:
+            lines += [f"### `{name}` — {type(obj).__name__}", ""]
+
+    import randomprojection_tpu.serialize as serialize
+    import randomprojection_tpu.streaming as streaming
+    import randomprojection_tpu.parallel as parallel
+    from randomprojection_tpu.ops import hashing, pallas_kernels, split_matmul
+    from randomprojection_tpu.parallel import distributed
+    from randomprojection_tpu.utils import observability
+
+    for title, mod in [
+        ("`randomprojection_tpu.streaming`", streaming),
+        ("`randomprojection_tpu.serialize`", serialize),
+        ("`randomprojection_tpu.parallel`", parallel),
+        ("`randomprojection_tpu.parallel.distributed`", distributed),
+        ("`randomprojection_tpu.ops.hashing`", hashing),
+        ("`randomprojection_tpu.ops.pallas_kernels`", pallas_kernels),
+        ("`randomprojection_tpu.ops.split_matmul`", split_matmul),
+        ("`randomprojection_tpu.utils.observability`", observability),
+    ]:
+        lines += [f"## {title}", ""]
+        for name in getattr(mod, "__all__", []):
+            obj = getattr(mod, name)
+            if inspect.isclass(obj):
+                lines.append(f"- **`{name}`** — {first_line(obj)}")
+            elif callable(obj):
+                lines.append(f"- **`{name}{sig(obj)}`** — {first_line(obj)}")
+            else:
+                lines.append(f"- **`{name}`** — {type(obj).__name__}")
+        lines.append("")
+
+    lines += [
+        "## `backend_options` (jax backend)", "",
+        "| key | values | effect |",
+        "|---|---|---|",
+        '| `precision` | `"default"`, `"high"` (f32 default), `"highest"`, '
+        '`"split2"` | MXU arithmetic for the projection matmul; `split2` = '
+        "X hi/lo bf16 split vs the exact ±1/0 mask (sparse/sign kinds only, "
+        "f32-grade) |",
+        '| `materialization` | `"dense"` (default), `"lazy"` | `lazy` '
+        "regenerates the mask in-kernel (Pallas, TPU only, sparse/sign "
+        "kinds): R never resides in HBM |",
+        '| `compute_dtype` | `"float32"` (default), `"bfloat16"` | on-device '
+        "compute dtype |",
+        "| `mesh` | a `jax.sharding.Mesh` | DP row-sharding of batches; R "
+        "replicated |",
+        "| `feature_axis` | mesh axis name | TP: shard the contraction dim "
+        "d; one `psum` per batch |",
+        '| `data_axis` | mesh axis name (default `"data"`) | row-sharding '
+        "axis |",
+        "",
+    ]
+    with open(OUT, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

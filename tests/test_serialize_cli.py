@@ -408,3 +408,27 @@ def test_save_load_preserves_countsketch_use_mxu(tmp_path):
     est2 = CountSketch(16, random_state=0, backend="numpy").fit(X)
     save_model(est2, p)
     assert load_model(p).use_mxu is None
+
+
+def test_api_doc_in_sync():
+    """docs/API.md is generated; fail if the surface changed without
+    regenerating (python docs/gen_api.py)."""
+    import pathlib
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    current = (repo / "docs" / "API.md").read_text()
+    with tempfile.TemporaryDirectory() as td:
+        out = pathlib.Path(td) / "API.md"
+        env = {"PYTHONPATH": str(repo), "PATH": "/usr/bin:/bin", "HOME": "/root",
+               "JAX_PLATFORMS": "cpu", "RP_API_OUT": str(out)}
+        subprocess.run(
+            [_sys.executable, str(repo / "docs" / "gen_api.py")],
+            check=True, env=env, timeout=240, capture_output=True,
+        )
+        regenerated = out.read_text()
+    assert regenerated == current, (
+        "docs/API.md is stale — run `python docs/gen_api.py`"
+    )
