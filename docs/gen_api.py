@@ -26,8 +26,11 @@ def sig(obj):
 
 
 def first_line(obj):
+    """Docstring summary: all lines up to the first blank (a hard
+    ``splitlines()[0]`` would cut wrapped summaries mid-sentence)."""
     d = inspect.getdoc(obj) or ""
-    return d.splitlines()[0] if d else ""
+    para = d.split("\n\n", 1)[0]
+    return " ".join(line.strip() for line in para.splitlines())
 
 
 def main():

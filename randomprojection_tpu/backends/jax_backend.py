@@ -326,7 +326,7 @@ class JaxBackend(ProjectionBackend):
         y, _ = self._transform_impl(X, state, spec)
         return y
 
-    def _prepare_rows(self, X):
+    def _prepare_rows(self, X, *, allow_bf16: bool = False):
         """Shared batch preamble: densify, cast, row-bucket pad, shard, place.
 
         Returns ``(x_on_device, n_real_rows, device_resident)``.
@@ -341,13 +341,27 @@ class JaxBackend(ProjectionBackend):
             if sp.issparse(X):
                 X = X.toarray()
 
+            # bf16 inputs stay bf16 through the h2d transfer (half the PCIe
+            # bytes — SURVEY.md §7 R3); einsum/type promotion upcasts on
+            # DEVICE, which is exact (every bf16 value is exact in f32).
+            # Gated on the spec's dtype policy (``allow_bf16``): an
+            # estimator fitted f32 must keep producing f32 even when handed
+            # a bf16 array.
+            keep_bf16 = (
+                allow_bf16
+                and getattr(X, "dtype", None) is not None
+                and jnp.dtype(X.dtype) == jnp.bfloat16
+            )
+
             if device_resident:
-                x = X.astype(jnp.dtype(self.compute_dtype))
+                x = X if keep_bf16 else X.astype(jnp.dtype(self.compute_dtype))
                 n = x.shape[0]
             else:
                 X = np.asarray(X)
                 n = X.shape[0]
-                x = np.ascontiguousarray(X, dtype=self.compute_dtype)
+                x = np.ascontiguousarray(
+                    X, dtype=None if keep_bf16 else self.compute_dtype
+                )
 
             from randomprojection_tpu.parallel.sharded import row_bucket
 
@@ -482,7 +496,9 @@ class JaxBackend(ProjectionBackend):
     def _transform_impl(self, X, state, spec: ProjectionSpec):
         from randomprojection_tpu.utils.observability import annotate
 
-        x, n, device_resident = self._prepare_rows(X)
+        x, n, device_resident = self._prepare_rows(
+            X, allow_bf16=spec.dtype == "bfloat16"
+        )
         with annotate("rp:backend/project"):
             return self._project_prepared(x, n, state, spec), device_resident
 
@@ -553,7 +569,9 @@ class JaxBackend(ProjectionBackend):
         else:
             from randomprojection_tpu.utils.observability import annotate
 
-            x, n, device_resident = self._prepare_rows(X)
+            x, n, device_resident = self._prepare_rows(
+                X, allow_bf16=spec.dtype == "bfloat16"
+            )
             with annotate("rp:backend/sign_project"):
                 y = self._slice_rows(self._sign_fn(x, state), n)
         if device_resident or not materialize:

@@ -30,6 +30,12 @@ __all__ = ["NumpyBackend"]
 _STREAM_SALT = 0x52503141  # "RP1A"
 
 
+def _bf16():
+    from randomprojection_tpu.utils.validation import bfloat16_dtype
+
+    return bfloat16_dtype()
+
+
 class NumpyBackend(ProjectionBackend):
     """Single-host CPU executor: ndarray / CSR state, BLAS matmuls."""
 
@@ -47,9 +53,15 @@ class NumpyBackend(ProjectionBackend):
             R = rademacher_random_matrix(spec.n_components, spec.n_features, rng)
         else:  # pragma: no cover - spec validates kind
             raise ValueError(spec.kind)
+        # bf16 specs keep R in f32: quantizing R to 8 mantissa bits would
+        # cost ~0.4% per entry (vs the ≤1e-3 distance budget); only the
+        # OUTPUT is bf16, matching the jax backend's f32-compute policy
+        store_dtype = (
+            np.float32 if spec.np_dtype == _bf16() else spec.np_dtype
+        )
         if sp.issparse(R):
-            return R.astype(spec.np_dtype)
-        return np.ascontiguousarray(R, dtype=spec.np_dtype)
+            return R.astype(store_dtype)
+        return np.ascontiguousarray(R, dtype=store_dtype)
 
     def transform(self, X, state, spec: ProjectionSpec, *, dense_output: bool = True):
         # scipy semantics (random_projection.py:825-827 via safe_sparse_dot):
@@ -60,11 +72,21 @@ class NumpyBackend(ProjectionBackend):
                 Y = Y.toarray()
             return Y
         X = np.asarray(X)
+        is_bf16_spec = spec.np_dtype == _bf16()
+        if is_bf16_spec and X.dtype == _bf16():
+            # scipy CSR cannot matmul against ml_dtypes arrays, and the
+            # dense product would be bf16×f32; compute in f32 (exact for
+            # bf16 values), cast the output back below
+            X = X.astype(np.float32)
         if sp.issparse(state):
             # dense X · sparse Rᵀ: compute (R · Xᵀ)ᵀ so the CSR matmul drives
-            Y = (state @ X.T).T
-            return np.ascontiguousarray(Y)
-        return X @ state.T
+            Y = np.ascontiguousarray((state @ X.T).T)
+        else:
+            Y = X @ state.T
+        # only the bf16 policy casts at the edge: f32-fit/f64-transform must
+        # keep returning f64 (sklearn parity, test_random_projection dtype
+        # contract)
+        return Y.astype(spec.np_dtype, copy=False) if is_bf16_spec else Y
 
     def inverse_components(self, state, spec: ProjectionSpec) -> np.ndarray:
         # pinv of the densified (k, d) matrix (random_projection.py:360-365)
@@ -74,7 +96,13 @@ class NumpyBackend(ProjectionBackend):
     def inverse_transform(self, Y, inverse_components, spec: ProjectionSpec):
         if sp.issparse(Y):
             Y = Y.toarray()
-        return np.asarray(Y) @ inverse_components.T
+        Y = np.asarray(Y)
+        if spec.np_dtype == _bf16():
+            # same bf16 edge policy as transform (cross-backend consistency)
+            return (
+                Y.astype(np.float32) @ inverse_components.T
+            ).astype(spec.np_dtype, copy=False)
+        return Y @ inverse_components.T
 
     def components_to_numpy(self, state, spec: ProjectionSpec):
         return state

@@ -13,12 +13,23 @@ import scipy.sparse as sp
 
 __all__ = [
     "DataDimensionalityWarning",
+    "bfloat16_dtype",
     "check_density",
     "check_input_size",
     "check_array",
     "resolve_transform_dtype",
     "NotFittedError",
 ]
+
+
+def bfloat16_dtype():
+    """np.dtype of bfloat16 (via ml_dtypes), or None when unavailable."""
+    try:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+        return None
 
 
 class DataDimensionalityWarning(UserWarning):
@@ -86,16 +97,26 @@ def check_array(X, *, accept_sparse: bool = True, allow_1d: bool = False):
         )
     if X.ndim not in (1, 2):
         raise ValueError(f"Expected 2D array, got ndim={X.ndim}")
-    if not np.issubdtype(X.dtype, np.number) and X.dtype != bool:
+    if (
+        not np.issubdtype(X.dtype, np.number)
+        and X.dtype != bool
+        and X.dtype != bfloat16_dtype()
+    ):
         raise ValueError(f"Expected numeric input, got dtype {X.dtype}")
     return X
 
 
 def resolve_transform_dtype(dtype) -> np.dtype:
-    """Dtype policy: f32 in → f32 out; f64 in → f64 out; everything else
-    (ints, bool, f16) promotes to f64 (``random_projection.py:386-387``,
-    ``test_random_projection.py:547-567``)."""
+    """Dtype policy: f32 in → f32 out; f64 in → f64 out; bf16 in → bf16 out
+    (TPU-native extension — halves the host↔device bytes, SURVEY.md §7 R3);
+    everything else (ints, bool, f16) promotes to f64
+    (``random_projection.py:386-387``, ``test_random_projection.py:547-567``;
+    IEEE f16 keeps the sklearn promotion contract — only the TPU-native
+    bfloat16 gets the pass-through)."""
     dtype = np.dtype(dtype)
     if dtype in (np.dtype(np.float32), np.dtype(np.float64)):
         return dtype
+    bf16 = bfloat16_dtype()
+    if bf16 is not None and dtype == bf16:
+        return bf16
     return np.dtype(np.float64)

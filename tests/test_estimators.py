@@ -269,3 +269,105 @@ def test_f32_f64_agreement(backend):
         ).fit(X.astype(dtype))
         return np.asarray(est.transform(X.astype(dtype)), dtype=np.float64)
     np.testing.assert_allclose(run(np.float32), run(np.float64), atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# bfloat16 input policy (TPU-native dtype extension)
+# ---------------------------------------------------------------------------
+
+
+def test_bfloat16_in_bfloat16_out_both_backends():
+    """bf16 in → bf16 out (halves h2d bytes, SURVEY §7 R3); R stays f32 on
+    both backends so only the OUTPUT is quantized; results agree with the
+    f32 pipeline at bf16 rounding (~0.4%).  IEEE float16 keeps the sklearn
+    promotion-to-f64 contract."""
+    import ml_dtypes
+
+    from randomprojection_tpu import GaussianRandomProjection
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    X32 = np.random.default_rng(0).normal(size=(200, 128)).astype(np.float32)
+    X16 = X32.astype(bf16)
+    for backend in ("numpy", "jax"):
+        est = GaussianRandomProjection(16, random_state=0, backend=backend)
+        Y16 = np.asarray(est.fit(X16).transform(X16))
+        assert Y16.dtype == bf16, (backend, Y16.dtype)
+        assert est.spec_.np_dtype == bf16
+        Y32 = np.asarray(
+            GaussianRandomProjection(16, random_state=0, backend=backend)
+            .fit(X32).transform(X32)
+        )
+        np.testing.assert_allclose(
+            Y16.astype(np.float32), Y32, rtol=2e-2, atol=2e-2
+        )
+
+    # float16 still promotes to f64 (sklearn contract)
+    est = GaussianRandomProjection(16, random_state=0, backend="numpy")
+    est.fit(X32.astype(np.float16))
+    assert est.spec_.np_dtype == np.dtype(np.float64)
+
+
+def test_bfloat16_sparse_split2_jax():
+    """bf16 input composes with the sparse kernel and split2 precision."""
+    import ml_dtypes
+
+    from randomprojection_tpu import SparseRandomProjection
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    X = np.random.default_rng(1).normal(size=(100, 256)).astype(np.float32)
+    est = SparseRandomProjection(
+        16, density=1 / 3, random_state=0, backend="jax",
+        backend_options={"precision": "split2"},
+    ).fit(X.astype(bf16))
+    Y = np.asarray(est.transform(X.astype(bf16)))
+    assert Y.dtype == bf16
+    R = est.components_as_numpy()
+    np.testing.assert_allclose(
+        Y.astype(np.float32), X @ R.T.astype(np.float32), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_bfloat16_sparse_numpy_and_dtype_parity_guards():
+    """Review regressions: (a) sparse kind on numpy backend accepts bf16;
+    (b) f32-fit + f64-transform still returns f64 (sklearn parity — the
+    bf16 edge cast must not leak); (c) an f32-fitted estimator handed a
+    bf16 array returns f32 (the spec, not the input, owns the out dtype);
+    (d) numpy/jax inverse_transform agree on bf16 output dtype."""
+    import ml_dtypes
+
+    from randomprojection_tpu import GaussianRandomProjection, SparseRandomProjection
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    X32 = np.random.default_rng(0).normal(size=(80, 128)).astype(np.float32)
+    X16 = X32.astype(bf16)
+
+    # (a) sparse kind, numpy backend, bf16 in -> bf16 out
+    est = SparseRandomProjection(
+        16, density=1 / 3, random_state=0, backend="numpy"
+    ).fit(X16)
+    Y = est.transform(X16)
+    assert np.asarray(Y).dtype == bf16
+
+    # (b) f32 fit, f64 transform input: numpy backend follows numpy
+    # promotion (f64 out, sklearn parity — the bf16 edge cast must not
+    # leak); the jax backend's documented policy is output-cast-to-spec
+    # (f32) since TPUs execute in f32 regardless
+    est_np = GaussianRandomProjection(16, random_state=0, backend="numpy").fit(X32)
+    assert np.asarray(est_np.transform(X32.astype(np.float64))).dtype == np.float64
+    est_jx = GaussianRandomProjection(16, random_state=0, backend="jax").fit(X32)
+    assert np.asarray(est_jx.transform(X32.astype(np.float64))).dtype == np.float32
+
+    # (c) f32 fit, bf16 input -> f32 out (spec owns the output dtype)
+    for est in (est_np, est_jx):
+        Yb = np.asarray(est.transform(X16))
+        assert Yb.dtype == np.float32, Yb.dtype
+
+    # (d) inverse_transform dtype agrees across backends for bf16 fits
+    inv_dtypes = set()
+    for backend in ("numpy", "jax"):
+        est = GaussianRandomProjection(
+            16, random_state=0, backend=backend, compute_inverse_components=True
+        ).fit(X16)
+        Xhat = est.inverse_transform(np.asarray(est.transform(X16)))
+        inv_dtypes.add(np.asarray(Xhat).dtype)
+    assert inv_dtypes == {bf16}, inv_dtypes
