@@ -69,10 +69,19 @@ class ProjectionSpec:
         if self.kind == "sparse":
             if self.density is None:
                 raise ValueError("kind='sparse' requires a resolved numeric density")
-        np.dtype(self.dtype)  # must be a valid dtype string
+        self.np_dtype  # must be a valid dtype string
 
     @property
     def np_dtype(self) -> np.dtype:
+        if self.dtype == "bfloat16":
+            # numpy only understands 'bfloat16' once ml_dtypes is imported;
+            # resolve via the helper so a bf16 model loads in a fresh
+            # process (serialize contract: the spec alone restores a model)
+            from randomprojection_tpu.utils.validation import bfloat16_dtype
+
+            dt = bfloat16_dtype()
+            if dt is not None:
+                return dt
         return np.dtype(self.dtype)
 
     def to_dict(self) -> dict:

@@ -73,10 +73,12 @@ class NumpyBackend(ProjectionBackend):
             return Y
         X = np.asarray(X)
         is_bf16_spec = spec.np_dtype == _bf16()
-        if is_bf16_spec and X.dtype == _bf16():
-            # scipy CSR cannot matmul against ml_dtypes arrays, and the
-            # dense product would be bf16×f32; compute in f32 (exact for
-            # bf16 values), cast the output back below
+        if X.dtype == _bf16():
+            # ALWAYS upcast bf16 input (exact): scipy CSR cannot matmul
+            # ml_dtypes arrays at all (f32-fitted sparse estimators would
+            # crash), and the dense product would be mixed bf16×f32.  The
+            # spec-gated cast below restores bf16 output when the spec
+            # says so; an f32 spec correctly yields f32.
             X = X.astype(np.float32)
         if sp.issparse(state):
             # dense X · sparse Rᵀ: compute (R · Xᵀ)ᵀ so the CSR matmul drives

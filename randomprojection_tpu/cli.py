@@ -91,6 +91,10 @@ def build_parser():
                    choices=["gaussian", "sparse", "sign", "countsketch"])
     q.add_argument("--density", default="auto")
     q.add_argument("--eps", type=float, default=0.1)
+    q.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="input dtype: bfloat16 halves the h2d bytes "
+                        "(bf16 in -> bf16 out policy)")
     _add_common(q)
 
     return p
@@ -276,6 +280,10 @@ def cmd_stream_bench(args):
     from randomprojection_tpu.utils.observability import StreamStats, profile_trace
 
     X = np.random.default_rng(0).normal(size=(args.rows, args.d)).astype(np.float32)
+    if getattr(args, "dtype", "float32") == "bfloat16":
+        import ml_dtypes
+
+        X = X.astype(ml_dtypes.bfloat16)
     args.n_components = args.k
     est = _make_estimator(args).fit(X)
     # warmup compile on one batch
@@ -291,6 +299,7 @@ def cmd_stream_bench(args):
         "value": round(args.rows / elapsed, 1),
         "unit": "rows/s",
         "kind": args.kind,
+        "dtype": str(X.dtype),
         "backend": args.backend,
         "backend_options": _backend_options(args),
         "bytes_in": stats.bytes_in,

@@ -432,3 +432,60 @@ def test_api_doc_in_sync():
     assert regenerated == current, (
         "docs/API.md is stale — run `python docs/gen_api.py`"
     )
+
+
+def test_stream_bench_bf16_dtype(capsys):
+    from randomprojection_tpu import cli
+
+    cli.main([
+        "stream-bench", "--rows", "256", "--d", "64", "--k", "16",
+        "--batch-rows", "128", "--kind", "gaussian", "--backend", "jax",
+        "--dtype", "bfloat16",
+    ])
+    out = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert out["dtype"] == "bfloat16" and out["value"] > 0
+    # half the f32 bytes crossed the link
+    assert out["bytes_in"] == 256 * 64 * 2
+
+
+def test_bf16_model_loads_in_fresh_process(tmp_path):
+    """A bf16-fitted model must reload in a fresh interpreter where
+    ml_dtypes was never imported (np.dtype('bfloat16') alone raises there;
+    the spec resolves it via the helper)."""
+    import ml_dtypes
+
+    X = np.random.default_rng(0).normal(size=(30, 64)).astype(ml_dtypes.bfloat16)
+    est = GaussianRandomProjection(8, random_state=0, backend="numpy").fit(X)
+    p = str(tmp_path / "m16.json")
+    save_model(est, p)
+
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from randomprojection_tpu.serialize import load_model\n"
+        "est = load_model(%r, backend='numpy')\n"
+        "print(est.spec_.dtype)\n"
+    ) % (str(__import__('pathlib').Path(__file__).resolve().parents[1]), p)
+    r = subprocess.run(
+        [sys.executable, "-I", "-c", code],
+        capture_output=True, text=True, timeout=240,
+        env={"PATH": "/usr/bin:/bin", "HOME": "/root", "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "bfloat16"
+
+
+def test_f32_sparse_estimator_accepts_bf16_input_numpy():
+    """Review regression: f32-fitted sparse estimator on the numpy backend
+    must not crash on bf16 input (scipy CSR can't matmul ml_dtypes); the
+    spec owns the output dtype, so the result is f32."""
+    import ml_dtypes
+
+    X32 = np.random.default_rng(0).normal(size=(50, 128)).astype(np.float32)
+    est = SparseRandomProjection(
+        8, density=1 / 3, random_state=0, backend="numpy"
+    ).fit(X32)
+    Y = np.asarray(est.transform(X32.astype(ml_dtypes.bfloat16)))
+    assert Y.dtype == np.float32
+    np.testing.assert_allclose(
+        Y, np.asarray(est.transform(X32)), rtol=2e-2, atol=2e-2
+    )
