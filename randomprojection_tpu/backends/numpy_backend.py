@@ -66,13 +66,17 @@ class NumpyBackend(ProjectionBackend):
     def transform(self, X, state, spec: ProjectionSpec, *, dense_output: bool = True):
         # scipy semantics (random_projection.py:825-827 via safe_sparse_dot):
         # output is sparse only if X is sparse AND dense_output=False.
+        is_bf16_spec = spec.np_dtype == _bf16()
         if sp.issparse(X):
             Y = X @ state.T
             if dense_output and sp.issparse(Y):
                 Y = Y.toarray()
+            if is_bf16_spec and not sp.issparse(Y):
+                # spec owns the output dtype regardless of input sparsity;
+                # CSR outputs stay f32 (scipy cannot hold ml_dtypes)
+                Y = Y.astype(spec.np_dtype, copy=False)
             return Y
         X = np.asarray(X)
-        is_bf16_spec = spec.np_dtype == _bf16()
         if X.dtype == _bf16():
             # ALWAYS upcast bf16 input (exact): scipy CSR cannot matmul
             # ml_dtypes arrays at all (f32-fitted sparse estimators would

@@ -176,7 +176,10 @@ def cmd_project(args):
     if args.input.endswith(".npz"):
         X = sp.load_npz(args.input).tocsr()
     else:
-        X = np.load(args.input, mmap_mode="r")
+        from randomprojection_tpu.utils.validation import restore_void_dtype
+
+        # restore bf16 arrays whose .npy header degraded to raw void
+        X = restore_void_dtype(np.load(args.input, mmap_mode="r"))
     source = ArraySource(X, args.batch_rows)
     stats = StreamStats(log_every=10)
     # np.save appends .npy itself; normalize once so the JSON summary and
@@ -281,9 +284,12 @@ def cmd_stream_bench(args):
 
     X = np.random.default_rng(0).normal(size=(args.rows, args.d)).astype(np.float32)
     if getattr(args, "dtype", "float32") == "bfloat16":
-        import ml_dtypes
+        from randomprojection_tpu.utils.validation import bfloat16_dtype
 
-        X = X.astype(ml_dtypes.bfloat16)
+        bf16 = bfloat16_dtype()
+        if bf16 is None:
+            raise SystemExit("--dtype bfloat16 requires ml_dtypes")
+        X = X.astype(bf16)
     args.n_components = args.k
     est = _make_estimator(args).fit(X)
     # warmup compile on one batch

@@ -318,6 +318,13 @@ def stream_to_memmap(
         # (the CLI's sidecar covers the full parameter set for CLI users)
         want_width = estimator._stream_out_width()
         want_dtype = estimator._stream_out_dtype()
+        if want_dtype is not None:
+            # .npy headers cannot express ml_dtypes names: a bf16 stream
+            # reloads as raw void ('|V2') — same bits, degraded label.
+            # Restore the typed view so the resume writes correctly.
+            from randomprojection_tpu.utils.validation import restore_void_dtype
+
+            out = restore_void_dtype(out, want_dtype)
         if out.ndim != 2 or out.shape[1] != want_width or (
             want_dtype is not None and out.dtype != np.dtype(want_dtype)
         ):

@@ -14,6 +14,7 @@ import scipy.sparse as sp
 __all__ = [
     "DataDimensionalityWarning",
     "bfloat16_dtype",
+    "restore_void_dtype",
     "check_density",
     "check_input_size",
     "check_array",
@@ -30,6 +31,28 @@ def bfloat16_dtype():
         return np.dtype(ml_dtypes.bfloat16)
     except ImportError:  # pragma: no cover - ml_dtypes ships with jax
         return None
+
+
+def restore_void_dtype(arr, want=None):
+    """Undo ``.npy``'s label degradation for ml_dtypes arrays.
+
+    ``np.save`` of a bfloat16 array writes a raw-void header (``|V2``) —
+    the format cannot express the name — so ``np.load`` returns unusable
+    void data.  When the array is unstructured 2-byte void and bfloat16 is
+    either the expected dtype (``want``) or the only plausible producer
+    (this stack writes no other 2-byte void), restore the typed view;
+    anything else passes through for the caller's validation to reject
+    loudly.
+    """
+    dtype = getattr(arr, "dtype", None)
+    if dtype is None or dtype.kind != "V" or dtype.names is not None:
+        return arr
+    bf16 = bfloat16_dtype()
+    if bf16 is None or dtype.itemsize != 2:
+        return arr
+    if want is not None and np.dtype(want) != bf16:
+        return arr
+    return arr.view(bf16)
 
 
 class DataDimensionalityWarning(UserWarning):

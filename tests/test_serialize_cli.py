@@ -489,3 +489,40 @@ def test_f32_sparse_estimator_accepts_bf16_input_numpy():
     np.testing.assert_allclose(
         Y, np.asarray(est.transform(X32)), rtol=2e-2, atol=2e-2
     )
+
+
+def test_cli_project_consumes_bf16_npy(tmp_path):
+    """The tool must consume its own bf16 outputs: np.load of a bf16 .npy
+    yields raw void ('|V2'); cmd_project restores the typed view."""
+    import ml_dtypes
+
+    from randomprojection_tpu import cli
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    X = np.random.default_rng(0).normal(size=(60, 32)).astype(bf16)
+    xin, yout = str(tmp_path / "x.npy"), str(tmp_path / "y.npy")
+    np.save(xin, X)
+    assert np.load(xin).dtype.kind == "V"  # the degradation being fixed
+    cli.main([
+        "project", "--input", xin, "--output", yout,
+        "--kind", "gaussian", "--n-components", "8", "--backend", "numpy",
+    ])
+    from randomprojection_tpu.utils.validation import restore_void_dtype
+
+    Y = restore_void_dtype(np.load(yout))
+    assert Y.shape == (60, 8) and Y.dtype == bf16
+
+
+def test_bf16_spec_output_dtype_independent_of_input_sparsity():
+    """A bf16-fitted estimator returns bf16 for dense AND sparse input
+    (dense outputs; CSR outputs stay f32 — scipy cannot hold ml_dtypes)."""
+    import ml_dtypes
+    import scipy.sparse as sp
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    X32 = np.random.default_rng(0).normal(size=(40, 64)).astype(np.float32)
+    est = GaussianRandomProjection(8, random_state=0, backend="numpy").fit(
+        X32.astype(bf16)
+    )
+    assert np.asarray(est.transform(X32)).dtype == bf16
+    assert np.asarray(est.transform(sp.csr_array(X32))).dtype == bf16

@@ -274,3 +274,44 @@ def test_memmap_resume_rejects_different_estimator_shape(tmp_path):
     )
     with pytest.raises(ValueError, match="mix two projections"):
         stream_to_memmap(est16_64, src, out_path, checkpoint_path=ckpt)
+
+
+def test_memmap_resume_bf16(tmp_path):
+    """bf16 streams write .npy files whose header degrades to raw void
+    ('|V2'); resume must restore the typed view and produce bit-identical
+    output, not refuse (same-width different-dtype estimators still
+    refuse)."""
+    import ml_dtypes
+
+    from randomprojection_tpu import GaussianRandomProjection
+    from randomprojection_tpu.streaming import (
+        ArraySource,
+        StreamCursor,
+        stream_to_memmap,
+    )
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    X = np.random.default_rng(0).normal(size=(300, 64)).astype(bf16)
+    est = GaussianRandomProjection(8, random_state=0, backend="numpy").fit(X)
+    src = ArraySource(X, 100)
+    out_path = str(tmp_path / "y.npy")
+    ckpt = str(tmp_path / "c.json")
+    ref = np.asarray(
+        stream_to_memmap(est, src, out_path, checkpoint_path=ckpt)
+    ).copy()
+    assert ref.dtype == bf16
+
+    StreamCursor(rows_done=100).save(ckpt)
+    out = stream_to_memmap(est, src, out_path, checkpoint_path=ckpt)
+    np.testing.assert_array_equal(
+        np.asarray(out).view(np.uint16), ref.view(np.uint16)
+    )
+
+    # an f32 estimator of the same width must still refuse (4-byte vs
+    # 2-byte itemsize; genuinely different projection)
+    StreamCursor(rows_done=100).save(ckpt)
+    est32 = GaussianRandomProjection(8, random_state=0, backend="numpy").fit(
+        np.asarray(X, dtype=np.float32)
+    )
+    with pytest.raises(ValueError, match="mix two projections"):
+        stream_to_memmap(est32, src, out_path, checkpoint_path=ckpt)
