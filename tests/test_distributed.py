@@ -180,7 +180,12 @@ def test_two_process_transform_matches_single(tmp_path):
     assert metas[0]["lo"] == 0 and metas[1]["hi"] == 301
     assert metas[0]["hi"] == metas[1]["lo"]
 
-    # single-process reference: same seed => same matrix => same output
+    # single-process reference: same seed => same matrix => same output.
+    # Workers always run on CPU; under RP_TEST_TPU=1 this reference runs on
+    # the real chip, whose f32 'high' mode (3-pass bf16) differs from true
+    # CPU f32 at ~1e-4 relative — the assertion checks partitioning and
+    # matrix identity, so distortion-level tolerance is the contract
+    # (wrong partitioning would be off by O(1), not O(1e-4)).
     from randomprojection_tpu import GaussianRandomProjection
 
     X = np.random.default_rng(0).normal(size=(301, 64)).astype(np.float32)
@@ -188,7 +193,7 @@ def test_two_process_transform_matches_single(tmp_path):
     est.fit_schema(*X.shape, dtype=X.dtype)
     ref = np.asarray(est.transform(X))
     got = np.concatenate([np.load(o) for o in outs])
-    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-3)
 
 
 def _free_port() -> int:
