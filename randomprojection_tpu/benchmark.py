@@ -252,6 +252,26 @@ def _scan_harness(jax, jnp, project, x0, steps, calls):
     return rows / elapsed, elapsed, float(np.asarray(jnp.stack(checks)).sum())
 
 
+def measure_config1() -> dict:
+    """Config-1 (BASELINE.json:7): Gaussian ``10k×512→64`` on the numpy
+    reference backend — the "PR1 ref" single-host CPU workload, measured
+    through the estimator path (BLAS GEMM underneath)."""
+    from randomprojection_tpu import GaussianRandomProjection
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((10_000, 512), dtype=np.float32)
+    est = GaussianRandomProjection(64, random_state=0, backend="numpy").fit(X)
+    est.transform(X[:100])  # warm BLAS
+    t0 = time.perf_counter()
+    est.transform(X)
+    dt = time.perf_counter() - t0
+    return {
+        "workload": "gaussian 10000x512->64, numpy backend (CPU reference)",
+        "rows_per_s": round(10_000 / dt, 1),
+        "elapsed_s": round(dt, 4),
+    }
+
+
 def measure_config3(preset: str = "full") -> dict:
     """Config-3 (BASELINE.json:9): very-sparse Li RP ``16384→512`` at
     ``density = 1/√d = 1/128``, data-resident, via the fused lazy Pallas
@@ -453,8 +473,10 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
         "timing_suspect": head["timing_suspect"],
         "elapsed_pass_invariant": elapsed_pass_invariant,
         "checksum": head["checksum"],
-        # per-config tracked numbers (BASELINE.json:9-11) so every workload
-        # has a recorded throughput; config3 needs the TPU-only lazy kernel
+        # per-config tracked numbers (BASELINE.json:7-11) so every workload
+        # has a recorded throughput; config2 IS the headline above; config3
+        # needs the TPU-only lazy kernel
+        "config1": measure_config1(),
         **(
             {"config3": measure_config3(preset)}
             if jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm")

@@ -193,7 +193,12 @@ def test_two_process_transform_matches_single(tmp_path):
     est.fit_schema(*X.shape, dtype=X.dtype)
     ref = np.asarray(est.transform(X))
     got = np.concatenate([np.load(o) for o in outs])
-    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-3)
+    if os.environ.get("RP_TEST_TPU", "") not in ("", "0"):
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-3)
+    else:
+        # all-CPU: both sides are true f32 — keep the tight contract so a
+        # numerics regression in either path cannot hide
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
 
 def _free_port() -> int:
