@@ -400,3 +400,39 @@ def test_lazy_on_cpu_fails_loudly():
             8, random_state=0, density=0.5, backend="jax",
             backend_options={"materialization": "lazy"},
         ).fit(X)
+
+
+@requires_tpu
+def test_lazy_streaming_matches_transform(tmp_path):
+    """Lazy materialization composes with the streaming layer: streamed
+    batches (including a ragged tail) must equal one-shot transform, and a
+    cursor resume must be bit-identical (the mask is a pure function of
+    (seed, block) — row batching cannot change it)."""
+    from randomprojection_tpu import SparseRandomProjection
+    from randomprojection_tpu.streaming import (
+        ArraySource,
+        StreamCursor,
+        stream_to_memmap,
+    )
+
+    X = np.random.default_rng(3).normal(size=(530, 1024)).astype(np.float32)
+    est = SparseRandomProjection(
+        32, density=1 / 3, random_state=9, backend="jax",
+        backend_options={"materialization": "lazy", "precision": "split2"},
+    ).fit(X)
+    ref = np.asarray(est.transform(X))
+
+    got = np.concatenate(
+        [y for _, y in est.transform_stream(ArraySource(X, 128))]
+    )
+    np.testing.assert_array_equal(got, ref)
+
+    out_path = str(tmp_path / "y.npy")
+    ckpt = str(tmp_path / "c.json")
+    stream_to_memmap(est, ArraySource(X, 128), out_path, checkpoint_path=ckpt)
+    first = np.load(out_path).copy()
+    np.testing.assert_array_equal(first, ref)
+    # rewind and resume: recomputation is bit-identical
+    StreamCursor(rows_done=256).save(ckpt)
+    stream_to_memmap(est, ArraySource(X, 128), out_path, checkpoint_path=ckpt)
+    np.testing.assert_array_equal(np.load(out_path), first)
