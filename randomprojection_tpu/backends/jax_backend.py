@@ -15,9 +15,10 @@ TPU-first decisions
 - **Sparse kernels are dense on device.**  The MXU consumes dense tiles; a
   k×d matrix is small (256×4096 f32 = 4 MiB).  Sparse *inputs* X are
   densified per batch.  ``dense_output`` is honored trivially (always dense).
-- **Static shapes for XLA.**  Batches are row-padded up to a bucket (next
-  power of two, min 8) so a streaming loop with ragged tails compiles O(log n)
-  programs, not one per batch shape.
+- **Static shapes for XLA.**  Batches are row-padded up to a bucket
+  (octave quarter-points, ≤25% waste, multiples of 8 —
+  ``parallel.sharded.row_bucket``) so a streaming loop with ragged tails
+  compiles O(log n) programs, not one per batch shape.
 - **Sharding-ready.**  Pass ``mesh=`` (a ``jax.sharding.Mesh``) and the
   backend places R replicated and shards batch rows over ``data_axis``; XLA
   inserts any needed collectives.  Same code, 1 chip or a pod slice
@@ -523,7 +524,7 @@ class JaxBackend(ProjectionBackend):
                     state.seed,
                     spec.n_components,
                     state.density,
-                    # x is already row-bucketed (power of two ≥ 8): matching
+                    # x is already row-bucketed (multiple of 8): matching
                     # the kernel row tile avoids re-padding small batches to
                     # BLOCK_N
                     block_n=min(BLOCK_N, x.shape[0]),

@@ -42,13 +42,28 @@ __all__ = [
 
 
 def row_bucket(n: int, mesh=None, data_axis: str = DATA_AXIS) -> int:
-    """Pad target for a batch of ``n`` rows: next power of two ≥ 8 (bounds
-    jit recompiles to O(log n) programs over a stream of ragged tails),
-    rounded up to a multiple of the mesh's data-axis size (shard_map and
-    row-sharded layouts need divisibility)."""
-    pad_to = max(8, 1 << (n - 1).bit_length())
+    """Pad target for a batch of ``n`` rows.
+
+    Buckets at the quarter-points of each power-of-two octave
+    (``{1, 1.25, 1.5, 1.75, 2}·2^k``): recompiles stay O(log n) over a
+    stream of ragged shapes while pad waste is capped at 25% — a bare
+    next-power-of-two bucket wastes up to 100% (a 65537-row batch would
+    compute 131072 rows).  The result is a multiple of 8 (f32 sublane
+    tiling); on a mesh it is additionally a multiple of 8×(data-axis
+    size), so shard_map divides evenly AND every per-shard row count
+    keeps the sublane tiling.
+    """
+    pow2 = max(8, 1 << (n - 1).bit_length())
+    if pow2 < 64:
+        pad_to = pow2  # tiny batches: waste is noise, keep one program
+    else:
+        step = pow2 // 8  # multiple of 8 whenever pow2 >= 64
+        for frac in (4, 5, 6, 7, 8):
+            pad_to = step * frac
+            if pad_to >= n:
+                break
     if mesh is not None:
-        pad_to += -pad_to % mesh.shape[data_axis]
+        pad_to += -pad_to % (8 * mesh.shape[data_axis])
     return pad_to
 
 
