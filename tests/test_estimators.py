@@ -371,3 +371,26 @@ def test_bfloat16_sparse_numpy_and_dtype_parity_guards():
         Xhat = est.inverse_transform(np.asarray(est.transform(X16)))
         inv_dtypes.add(np.asarray(Xhat).dtype)
     assert inv_dtypes == {bf16}, inv_dtypes
+
+
+def test_device_resident_input_stays_on_device():
+    """A jax-array input short-circuits host materialization: output is a
+    device handle with identical values to the host-input path (the
+    device-resident contract used by on-device pipelines)."""
+    import jax
+    import jax.numpy as jnp
+
+    from randomprojection_tpu import GaussianRandomProjection
+
+    X = np.random.default_rng(0).normal(size=(50, 64)).astype(np.float32)
+    est = GaussianRandomProjection(8, random_state=0, backend="jax").fit(X)
+    y_host = np.asarray(est.transform(X))
+    y_dev = est.transform(jnp.asarray(X))
+    assert isinstance(y_dev, jax.Array)  # no host round-trip
+    np.testing.assert_array_equal(np.asarray(y_dev), y_host)
+    # inverse_transform likewise keeps device inputs on device
+    inv = est.inverse_transform(y_dev)
+    assert isinstance(inv, jax.Array)
+    np.testing.assert_array_equal(
+        np.asarray(inv), np.asarray(est.inverse_transform(y_host))
+    )
