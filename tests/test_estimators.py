@@ -1,8 +1,9 @@
 """Estimator-layer tests (SURVEY.md §5 categories 1, 3, 4, 5).
 
 Contract source: sklearn test_random_projection.py (TRP.py in SURVEY.md),
-re-expressed against the new API.  Backend parity tests live in
-test_jax_backend.py.
+re-expressed against the new API.  Cross-backend parity is exercised here
+via the backend-parametrized tests (and in test_kernels.py /
+test_sklearn_parity.py at the kernel and contract levels).
 """
 
 import numpy as np
