@@ -43,6 +43,20 @@ def _add_common(p):
                         "debugging (orders slower)")
 
 
+def _positive_int(v: str) -> int:
+    i = int(v)
+    if i < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return i
+
+
+def _density_arg(v: str) -> float:
+    f = float(v)
+    if not 0.0 < f <= 1.0:
+        raise argparse.ArgumentTypeError(f"density must be in (0, 1], got {v}")
+    return f
+
+
 def _backend_options(args) -> dict:
     opts = {}
     if getattr(args, "precision", None):
@@ -75,12 +89,21 @@ def build_parser():
     q.add_argument("--eps", type=float, default=0.1)
     q.add_argument("--density", default="auto")
     q.add_argument("--batch-rows", type=int, default=65536)
+    q.add_argument("--pipeline-depth", type=_positive_int, default=2,
+                   help="batches kept in flight on the jax backend "
+                        "(double buffering); results are depth-invariant")
     q.add_argument("--checkpoint", default=None,
                    help="cursor path for resume")
     _add_common(q)
 
     q = sub.add_parser("bench", help="data-resident north-star metric")
     q.add_argument("--preset", default="smoke", choices=["smoke", "full"])
+    q.add_argument("--d", type=int, default=4096,
+                   help="input dimension for the headline modes")
+    q.add_argument("--k", type=int, default=256,
+                   help="output dimension for the headline modes")
+    q.add_argument("--density", type=_density_arg, default=1.0 / 3.0,
+                   help="mask density for the headline modes")
 
     q = sub.add_parser("stream-bench", help="host-streamed throughput")
     q.add_argument("--rows", type=int, default=262144)
@@ -189,7 +212,10 @@ def cmd_project(args):
     if args.checkpoint is None:
         est = _make_estimator(args).fit_source(source)
         with profile_trace(args.profile_dir):
-            Y = stream_to_array(est, source, stats=stats)
+            Y = stream_to_array(
+                est, source, stats=stats,
+                pipeline_depth=args.pipeline_depth,
+            )
         if sp.issparse(Y):
             Y = Y.toarray()
         np.save(out_path, Y)
@@ -258,6 +284,7 @@ def cmd_project(args):
             out = stream_to_memmap(
                 est, source, out_path,
                 checkpoint_path=args.checkpoint, stats=stats,
+                pipeline_depth=args.pipeline_depth,
             )
     except ValueError as e:
         raise SystemExit(str(e))
@@ -266,9 +293,10 @@ def cmd_project(args):
 
 
 def cmd_bench(args):
-    from randomprojection_tpu.benchmark import main as bench_main
+    from randomprojection_tpu.benchmark import run
 
-    bench_main(args.preset)
+    print(json.dumps(run(args.preset, k=args.k, d=args.d,
+                         density=args.density)))
 
 
 def cmd_stream_bench(args):

@@ -526,3 +526,54 @@ def test_bf16_spec_output_dtype_independent_of_input_sparsity():
     )
     assert np.asarray(est.transform(X32)).dtype == bf16
     assert np.asarray(est.transform(sp.csr_array(X32))).dtype == bf16
+
+
+def test_cli_bench_forwards_custom_shapes(monkeypatch, capsys):
+    from randomprojection_tpu import benchmark, cli
+
+    captured = {}
+
+    def fake_run(preset, k=256, d=4096, density=1 / 3):
+        captured.update(preset=preset, k=k, d=d, density=density)
+        return {"metric": "fake", "value": 1}
+
+    monkeypatch.setattr(benchmark, "run", fake_run)
+    cli.main(["bench", "--preset", "smoke", "--d", "512", "--k", "32",
+              "--density", "0.5"])
+    assert captured == {"preset": "smoke", "k": 32, "d": 512, "density": 0.5}
+    assert json.loads(capsys.readouterr().out)["metric"] == "fake"
+
+
+def test_cli_project_pipeline_depth(tmp_path):
+    """--pipeline-depth varies buffering only: output identical to default."""
+    from randomprojection_tpu import cli
+
+    X = np.random.default_rng(0).normal(size=(150, 32)).astype(np.float32)
+    xin = str(tmp_path / "x.npy")
+    np.save(xin, X)
+    outs = []
+    for depth, name in (("2", "a.npy"), ("4", "b.npy")):
+        yout = str(tmp_path / name)
+        cli.main([
+            "project", "--input", xin, "--output", yout,
+            "--kind", "gaussian", "--n-components", "8",
+            "--backend", "jax", "--batch-rows", "50",
+            "--pipeline-depth", depth, "--seed", "3",
+        ])
+        outs.append(np.load(yout))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_cli_argument_validation():
+    """Bad --pipeline-depth / --density values are rejected at parse time
+    with a clean error, not a deep traceback."""
+    from randomprojection_tpu import cli
+
+    for argv in (
+        ["project", "--input", "x", "--output", "y", "--pipeline-depth", "0"],
+        ["bench", "--density", "0"],
+        ["bench", "--density", "1.5"],
+        ["bench", "--density", "-0.2"],
+    ):
+        with pytest.raises(SystemExit):
+            cli.build_parser().parse_args(argv)
