@@ -1,0 +1,107 @@
+"""Round-4 kernel attribution probe (VERDICT r3 missing #1).
+
+Measures, on the real chip, where fused_sparse_project's time goes:
+- current kernel at block_n in {256, 512, 1024}
+- a mask-free variant (constant mask, same dots) = matmul-only ceiling
+- a regen-once variant is approximated by the ratio of the two
+
+All numbers go through the bench's anti-cache scan harness; on this box
+wall-clock is dispatch-polluted, so only RELATIVE comparisons within one
+run are meaningful (BASELINE.md).  Run: python experiments/kernel_probe.py
+"""
+
+import functools
+import math
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from randomprojection_tpu.benchmark import _scan_harness  # noqa: E402
+from randomprojection_tpu.ops.pallas_kernels import (  # noqa: E402
+    BLOCK_D,
+    _mask_block,
+    fused_sparse_project,
+)
+from randomprojection_tpu.ops.split_matmul import split_f32_to_bf16_pair  # noqa: E402
+
+_DOT_KD = (((1,), (1,)), ((), ()))
+
+
+def _probe_kernel(seed_ref, x_ref, o_ref, *, k, density, scale, n_blocks_d,
+                  mxu_mode, mask_mode):
+    j = pl.program_id(1)
+    if mask_mode == "regen":
+        pltpu.prng_seed(seed_ref[0], j)
+        r = _mask_block(density)((k, x_ref.shape[1]))
+    else:  # constant mask: isolates the dots
+        r = jnp.full((k, x_ref.shape[1]), 0.001, jnp.float32)
+
+    @pl.when(j == 0)
+    def _():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    if mxu_mode == "split2":
+        x_hi, x_lo = split_f32_to_bf16_pair(x_ref[:])
+        r16 = r.astype(jnp.bfloat16)
+        acc = jax.lax.dot_general(x_hi, r16, dimension_numbers=_DOT_KD,
+                                  preferred_element_type=jnp.float32)
+        acc += jax.lax.dot_general(x_lo, r16, dimension_numbers=_DOT_KD,
+                                   preferred_element_type=jnp.float32)
+        o_ref[:] += acc
+    else:
+        o_ref[:] += jax.lax.dot_general(x_ref[:], r, dimension_numbers=_DOT_KD,
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_blocks_d - 1)
+    def _():
+        o_ref[:] = o_ref[:] * scale
+
+
+@functools.partial(jax.jit, static_argnames=("k", "density", "block_n",
+                                             "mxu_mode", "mask_mode"))
+def probe_project(x, k, density, block_n, mxu_mode, mask_mode):
+    n, d = x.shape
+    scale = 1.0 / math.sqrt(density * k)
+    ni, nj = n // block_n, d // BLOCK_D
+    seed_arr = jnp.asarray([0, 0], dtype=jnp.int32)
+    return pl.pallas_call(
+        functools.partial(_probe_kernel, k=k, density=density, scale=scale,
+                          n_blocks_d=nj, mxu_mode=mxu_mode, mask_mode=mask_mode),
+        grid=(ni, nj),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((block_n, BLOCK_D), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_n, k), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, k), jnp.float32),
+    )(seed_arr, x)
+
+
+def main():
+    d, k, density = 4096, 256, 1.0 / 3.0
+    batch, steps, calls = 16384, 32, 3
+    x0 = jax.random.normal(jax.random.key(1), (batch, d), jnp.float32)
+    print(f"probe: batch={batch} d={d} k={k} steps={steps} calls={calls}")
+    for mxu_mode in ("split2", "f32"):
+        passes = 2 if mxu_mode == "split2" else 1
+        for mask_mode in ("regen", "const"):
+            for block_n in (256, 512, 1024, 2048):
+                fn = lambda x: probe_project(  # noqa: E731
+                    x, k, density, block_n, mxu_mode, mask_mode)
+                rate, elapsed, _ = _scan_harness(jax, jnp, fn, x0, steps, calls)
+                tflops = rate * passes * 2 * d * k / 1e12
+                print(f"  {mxu_mode:6s} mask={mask_mode:5s} block_n={block_n:4d}"
+                      f"  {rate/1e6:7.2f}M rows/s  executed {tflops:6.1f}"
+                      f" TFLOP/s  ({100*tflops/197:.0f}% peak)")
+
+
+if __name__ == "__main__":
+    main()

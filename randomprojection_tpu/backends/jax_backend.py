@@ -417,7 +417,7 @@ class JaxBackend(ProjectionBackend):
         """
         return "f32" if self.precision == "default" else "split2"
 
-    def _get_lazy_mesh_fn(self, state, spec: ProjectionSpec):
+    def _get_lazy_mesh_fn(self, state, spec: ProjectionSpec, mxu_mode: str):
         """shard_map'd fused lazy projection over the mesh.
 
         DP: each device runs the fused kernel on its row shard — the matrix
@@ -429,7 +429,6 @@ class JaxBackend(ProjectionBackend):
         feature axis completes the contraction — same collective budget as
         the dense TP path, still no R in HBM anywhere.
         """
-        mxu_mode = self._lazy_mxu_mode()
         cache_key = (state.seed, state.density, spec.n_components, mxu_mode)
         fn = self._lazy_mesh_fns.get(cache_key)
         if fn is not None:
@@ -509,10 +508,19 @@ class JaxBackend(ProjectionBackend):
                 x.astype(self._jax.numpy.float32), state.mask, state.scale
             ).astype(x.dtype)
         elif isinstance(state, _LazyMask):
+            jnp = self._jax.numpy
+            # bf16 input (only possible when the spec's dtype policy allowed
+            # it in _prepare_rows) stays bf16 through the fused kernel: one
+            # MXU pass against the exact mask IS the data's own precision,
+            # at half the x HBM traffic of the f32 modes.
+            if x.dtype == jnp.bfloat16:
+                mxu_mode, xc = "bf16", x
+            else:
+                mxu_mode, xc = self._lazy_mxu_mode(), x.astype(jnp.float32)
             if self.mesh is not None:
-                y = self._get_lazy_mesh_fn(state, spec)(
-                    x.astype(self._jax.numpy.float32)
-                ).astype(x.dtype)
+                y = self._get_lazy_mesh_fn(state, spec, mxu_mode)(xc).astype(
+                    x.dtype
+                )
             else:
                 from randomprojection_tpu.ops.pallas_kernels import (
                     BLOCK_N,
@@ -520,7 +528,7 @@ class JaxBackend(ProjectionBackend):
                 )
 
                 y = fused_sparse_project(
-                    x.astype(self._jax.numpy.float32),
+                    xc,
                     state.seed,
                     spec.n_components,
                     state.density,
@@ -528,7 +536,7 @@ class JaxBackend(ProjectionBackend):
                     # the kernel row tile avoids re-padding small batches to
                     # BLOCK_N
                     block_n=min(BLOCK_N, x.shape[0]),
-                    mxu_mode=self._lazy_mxu_mode(),
+                    mxu_mode=mxu_mode,
                 ).astype(x.dtype)
         else:
             y = self._get_transform_fn()(x, state)

@@ -50,6 +50,11 @@ __all__ = ["BLOCK_D", "BLOCK_N", "fused_sparse_project", "pallas_sparse_matrix"]
 BLOCK_D = 512  # contraction-dim tile; part of the matrix definition
 BLOCK_N = 256  # row tile (tunable; does NOT affect the matrix)
 
+# Mosaic's scoped-VMEM limit is 16 MiB; the mask cache gets what is left
+# after the pipeline's own buffers, with headroom for Mosaic temporaries.
+_VMEM_LIMIT = 16 << 20
+_VMEM_HEADROOM = 2 << 20
+
 
 def _seed_to_i32(seed) -> int:
     """Fold any Python int seed into int32 (the SMEM scalar width).
@@ -86,15 +91,46 @@ def _mask_block(density):
 _DOT_KD = (((1,), (1,)), ((), ()))  # x[n,d] · r[k,d] → [n,k]
 
 
-def _project_kernel(seed_ref, x_ref, o_ref, *, k, density, scale, n_blocks_d,
-                    mxu_mode):
+def _project_kernel(seed_ref, x_ref, o_ref, *scratch, k, density, scale,
+                    n_blocks_d, mxu_mode, cache_blocks):
+    i = pl.program_id(0)
     j = pl.program_id(1)
-    # (seed, global block) → bits: row-tile-free.  seed_ref[1] is the
-    # column-block offset of this shard under feature-axis TP (0 unsharded),
-    # so a shard holding X[:, lo:hi] regenerates exactly the mask blocks of
-    # its own column range — the same global matrix, distributed.
-    pltpu.prng_seed(seed_ref[0], j + seed_ref[1])
-    r = _mask_block(density)((k, x_ref.shape[1]))
+
+    def _gen_mask(dtype):
+        # (seed, global block) → bits: row-tile-free.  seed_ref[1] is the
+        # column-block offset of this shard under feature-axis TP (0
+        # unsharded), so a shard holding X[:, lo:hi] regenerates exactly
+        # the mask blocks of its own column range — the same global
+        # matrix, distributed.
+        pltpu.prng_seed(seed_ref[0], j + seed_ref[1])
+        # the bf16 cast is exact: entries are {+1, -1, 0}
+        return _mask_block(density)((k, x_ref.shape[1])).astype(dtype)
+
+    # Mask-block VMEM cache (round-4 probe finding: in the MXU-bound regime
+    # — large k — regenerating the mask per (row tile, column block) grid
+    # step costs ~half the throughput; with a constant mask the same dot
+    # pipeline runs at ~86% of peak).  ``scratch[0]`` is a persistent VMEM
+    # scratch of ``cache_blocks`` mask blocks (+1 shared regen slot when
+    # not every block fits): block j's mask is GENERATED once, on the first
+    # row tile, and re-read from VMEM by every later row tile — identical
+    # values (the (seed, block) stream is unchanged), ~zero VPU cost after
+    # row tile 0.  Overflow blocks (j >= cache_blocks) share the last slot
+    # and regenerate every step, exactly like the pre-cache kernel.  When
+    # even one slot doesn't fit in scoped VMEM there is no scratch at all
+    # and every step regenerates (the pre-cache kernel, byte for byte).
+    if not scratch:
+        r = _gen_mask(jnp.bfloat16 if mxu_mode != "f32" else jnp.float32)
+    else:
+        r_ref = scratch[0]
+        full = cache_blocks >= n_blocks_d
+        slot = j if full else jnp.minimum(j, cache_blocks)
+        gen = (i == 0) if full else jnp.logical_or(i == 0, j >= cache_blocks)
+
+        @pl.when(gen)
+        def _():
+            r_ref[slot] = _gen_mask(r_ref.dtype)
+
+        r = r_ref[slot]
 
     @pl.when(j == 0)
     def _():
@@ -109,17 +145,20 @@ def _project_kernel(seed_ref, x_ref, o_ref, *, k, density, scale, n_blocks_d,
         # f32 — f32-grade output at 2 MXU passes per block, no R and no
         # X-halves traffic in HBM.
         x_hi, x_lo = split_f32_to_bf16_pair(x_ref[:])
-        r16 = r.astype(jnp.bfloat16)  # exact: entries are {+1, -1, 0}
         acc = jax.lax.dot_general(
-            x_hi, r16, dimension_numbers=_DOT_KD,
+            x_hi, r, dimension_numbers=_DOT_KD,
             preferred_element_type=jnp.float32,
         )
         acc += jax.lax.dot_general(
-            x_lo, r16, dimension_numbers=_DOT_KD,
+            x_lo, r, dimension_numbers=_DOT_KD,
             preferred_element_type=jnp.float32,
         )
         o_ref[:] += acc
     else:
+        # 'bf16': x arrives bf16 (the data's own precision — half the x
+        # HBM traffic of the f32 modes) and contracts against the exact
+        # bf16 mask in ONE MXU pass with f32 accumulation.
+        # 'f32': single f32 dot at Mosaic's default precision.
         o_ref[:] += jax.lax.dot_general(
             x_ref[:], r, dimension_numbers=_DOT_KD,
             preferred_element_type=jnp.float32,
@@ -169,15 +208,20 @@ def fused_sparse_project(
     unsharded result.
 
     ``mxu_mode`` selects the contraction arithmetic — NOT part of the matrix
-    definition (both modes contract the identical mask):
+    definition (all modes contract the identical mask):
 
     - ``'f32'``: f32 dot at Mosaic's default precision (bf16-grade output).
     - ``'split2'``: X split hi/lo bf16 in VMEM vs the exact-in-bf16 mask —
       2 single-pass MXU contractions, f32-grade output (~1e-6 distortion),
       the mode that reaches the T1 roofline (~R1/2 ≈ 47-94M rows/s).
+    - ``'bf16'``: X kept bfloat16 end-to-end (half the x HBM traffic — the
+      mode for bf16-fitted models, where 1 exact-mask pass IS the data's
+      own precision), 1 MXU pass, f32 accumulation.
     """
-    if mxu_mode not in ("f32", "split2"):
-        raise ValueError(f"mxu_mode must be 'f32' or 'split2', got {mxu_mode!r}")
+    if mxu_mode not in ("f32", "split2", "bf16"):
+        raise ValueError(
+            f"mxu_mode must be 'f32', 'split2' or 'bf16', got {mxu_mode!r}"
+        )
     density = check_density(density, x.shape[1])
     check_input_size(n_components, x.shape[1])
     if n_components % 8:
@@ -194,9 +238,46 @@ def fused_sparse_project(
     d_pad = -d % BLOCK_D
     if n_pad or d_pad:
         x = jnp.pad(x, ((0, n_pad), (0, d_pad)))
-    x = x.astype(jnp.float32)
+    x = x.astype(jnp.bfloat16 if mxu_mode == "bf16" else jnp.float32)
+    x_itemsize = x.dtype.itemsize
     ni = x.shape[0] // block_n
     nj = x.shape[1] // BLOCK_D
+
+    # Mask-cache sizing: the cache holds the mask in the dtype the dot
+    # consumes (bf16 for split2/bf16 — exact for ±1/0 — f32 otherwise) and
+    # takes whatever scoped VMEM remains after the pipeline's own buffers
+    # (x double-buffered, o block, the f32 generation temporary, the split
+    # halves) plus headroom.  The overflow regen slot counts against the
+    # same budget (``max_slots - 1``): cache_blocks == 0 degenerates to the
+    # original regenerate-every-step kernel via the single shared slot, and
+    # when not even that one slot fits the kernel gets NO scratch and
+    # regenerates into a value, so no shape that compiled pre-cache can be
+    # pushed over Mosaic's scoped-VMEM limit by the cache.
+    cache_itemsize = 4 if mxu_mode == "f32" else 2
+    block_bytes = k * BLOCK_D * cache_itemsize
+    reserved = (
+        2 * block_n * BLOCK_D * x_itemsize  # x pipeline (double-buffered)
+        + 2 * block_n * k * 4               # o block (+ revolving copy)
+        + k * BLOCK_D * 4                   # mask generation temporary
+        + (2 * block_n * BLOCK_D * 2 if mxu_mode == "split2" else 0)
+        + _VMEM_HEADROOM
+    )
+    max_slots = max(0, _VMEM_LIMIT - reserved) // block_bytes
+    cache_blocks = nj if max_slots >= nj else max(0, max_slots - 1)
+    slots = nj if cache_blocks >= nj else cache_blocks + 1
+    # ni == 1: every block is generated once and read once — nothing to
+    # reuse, so the cache would only add a VMEM round-trip per step; keep
+    # the single-row-tile path byte-for-byte the pre-cache kernel
+    scratch_shapes = (
+        [
+            pltpu.VMEM(
+                (slots, k, BLOCK_D),
+                jnp.float32 if cache_itemsize == 4 else jnp.bfloat16,
+            )
+        ]
+        if max_slots > 0 and ni > 1
+        else []
+    )
 
     seed_arr = jnp.stack(
         [jnp.int32(seed), jnp.asarray(block_offset, dtype=jnp.int32)]
@@ -204,7 +285,7 @@ def fused_sparse_project(
     y = pl.pallas_call(
         functools.partial(
             _project_kernel, k=k, density=density, scale=scale, n_blocks_d=nj,
-            mxu_mode=mxu_mode,
+            mxu_mode=mxu_mode, cache_blocks=cache_blocks,
         ),
         grid=(ni, nj),
         in_specs=[
@@ -219,11 +300,14 @@ def fused_sparse_project(
             (block_n, k), lambda i, j: (i, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((x.shape[0], k), jnp.float32),
+        scratch_shapes=scratch_shapes,
         cost_estimate=pl.CostEstimate(
             # split2 executes two MXU contractions per block
             flops=(2 if mxu_mode == "split2" else 1)
             * 2 * x.shape[0] * x.shape[1] * k,
-            bytes_accessed=x.shape[0] * x.shape[1] * 4 + x.shape[0] * k * 4,
+            bytes_accessed=(
+                x.shape[0] * x.shape[1] * x_itemsize + x.shape[0] * k * 4
+            ),
             transcendentals=0,
         ),
         interpret=interpret,

@@ -179,9 +179,9 @@ def test_structural_invariants_everywhere():
 
     # ragged n and d are padded to (block_n, BLOCK_D) multiples internally
     # and sliced back: output shape must be exact for any input shape, in
-    # both MXU modes (the mode changes arithmetic, never the contract)
+    # every MXU mode (the mode changes arithmetic, never the contract)
     for n, d, k in [(300, 700, 32), (1, 1, 8), (256, 512, 64), (257, 513, 8)]:
-        for mode in ("f32", "split2"):
+        for mode in ("f32", "split2", "bf16"):
             out = jax.eval_shape(
                 lambda a, k=k, mode=mode: fused_sparse_project(
                     a, 0, k, 0.5, mxu_mode=mode
@@ -323,6 +323,80 @@ def test_lazy_tp_alignment_validated_at_fit():
                 "materialization": "lazy",
             },
         ).fit(X)
+
+
+@requires_tpu
+def test_fused_bf16_mode(x):
+    """mxu_mode='bf16': bf16 input contracts the SAME matrix in one exact
+    MXU pass — near-exact vs the f64 contraction of the bf16 data (products
+    of bf16 values with {±1, 0} are exact; only the f32 accumulation
+    rounds), at half the x HBM traffic."""
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.ops.pallas_kernels import (
+        fused_sparse_project,
+        pallas_sparse_matrix,
+    )
+
+    k = 32
+    x16 = jnp.asarray(x, dtype=jnp.bfloat16)
+    y = np.asarray(
+        fused_sparse_project(x16, 42, k, 1 / 3, mxu_mode="bf16")
+    )
+    R = np.asarray(pallas_sparse_matrix(42, k, x.shape[1], 1 / 3))
+    ref = np.asarray(x16).astype(np.float64) @ R.astype(np.float64).T
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+@requires_tpu
+def test_lazy_bf16_estimator_end_to_end():
+    """A bf16-fitted lazy model keeps x bf16 through the fused kernel: the
+    output must match the f64 contraction of the bf16 data against the
+    kernel's own matrix at the data's precision (distortion no worse than
+    the dense-bf16 mode's — VERDICT r3 missing #5)."""
+    from randomprojection_tpu import SparseRandomProjection
+    from randomprojection_tpu.utils.validation import bfloat16_dtype
+
+    bf16 = bfloat16_dtype()
+    if bf16 is None:
+        pytest.skip("ml_dtypes bfloat16 unavailable")
+    X = np.random.default_rng(4).normal(size=(256, 1024)).astype(np.float32)
+    X16 = X.astype(bf16)
+    est = SparseRandomProjection(
+        64, density=1 / 3, random_state=11, backend="jax",
+        backend_options={"materialization": "lazy"},
+    ).fit(X16)
+    Y16 = np.asarray(est.transform(X16))
+    assert Y16.dtype == bf16  # bf16 in → bf16 out
+    Y = Y16.astype(np.float64)
+    R = est.components_as_numpy().astype(np.float64)
+    ref = X16.astype(np.float64) @ R.T
+    # Y is itself bf16 at the host edge: agreement is bf16-grade
+    np.testing.assert_allclose(Y, ref, rtol=1e-2, atol=0.05)
+
+
+@requires_tpu
+def test_mask_cache_respects_vmem_limit():
+    """Large-k regression (round-4 review finding): the mask-block cache
+    must never push a shape over Mosaic's scoped-VMEM limit.  At k=2048
+    one f32 cache slot is 4 MiB — the sizing must budget the +1 overflow
+    regen slot against the same pool (or drop the scratch entirely) so the
+    kernel still compiles, degenerating to regenerate-every-step."""
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.ops.pallas_kernels import (
+        fused_sparse_project,
+        pallas_sparse_matrix,
+    )
+
+    x = np.random.default_rng(1).standard_normal((512, 8192)).astype(np.float32)
+    R = np.asarray(pallas_sparse_matrix(7, 2048, 8192, 1 / 3))
+    ref = x.astype(np.float64) @ R.astype(np.float64).T
+    scale = np.std(ref)
+    y32 = np.asarray(
+        fused_sparse_project(jnp.asarray(x), 7, 2048, 1 / 3, mxu_mode="split2")
+    )
+    assert np.max(np.abs(y32 - ref)) / scale < 1e-4  # f32-grade
 
 
 @requires_tpu
