@@ -22,7 +22,7 @@ PRESETS = {
     # because a dispatch costs ~100-133 ms on the virtualized dev chip
     # (BASELINE.md round-3 finding): work per dispatch must dwarf the
     # dispatch overhead or the bench measures the tunnel, not the chip.
-    "full": dict(batch=131072, steps=64, calls=3),   # 8.4M rows per call
+    "full": dict(batch=131072, steps=128, calls=3),  # 16.8M rows per call
     "smoke": dict(batch=8192, steps=2, calls=2),
 }
 
@@ -44,17 +44,19 @@ def _mode_project_fn(jax, jnp, name, scale, *, k=None, density=None,
     matching materialized matrix (``pallas_sparse_matrix``) as ``R_f32`` so
     the distortion reference contracts the identical matrix.
     """
-    if name in ("lazy", "lazy_split2"):
+    if name in ("lazy", "lazy_split2", "lazy_bf16"):
         from randomprojection_tpu.ops.pallas_kernels import fused_sparse_project
 
-        mxu_mode = "split2" if name == "lazy_split2" else "f32"
+        mxu_mode = {"lazy": "f32", "lazy_split2": "split2",
+                    "lazy_bf16": "bf16"}[name]
 
         def project(x, r):  # r unused by design: zero R HBM traffic
             return fused_sparse_project(
                 x, lazy_seed, k, density, mxu_mode=mxu_mode
             )
 
-        return project, jnp.float32, lambda R_f32: R_f32
+        in_dtype = jnp.bfloat16 if name == "lazy_bf16" else jnp.float32
+        return project, in_dtype, lambda R_f32: R_f32
 
     if name == "bf16_split2":
         from randomprojection_tpu.ops.split_matmul import split2_project
@@ -157,6 +159,25 @@ def measure_distortion(jax, jnp, R_f32, x_cpu, name, scale, **mode_kw):
     return float(np.max(np.abs(pdist2(y_dev) / pdist2(y_ref) - 1.0)))
 
 
+def _host_best_of(sample, trials: int = 3):
+    """Guard for host-side wall-clock samples (VERDICT r3 missing #3: a
+    single 0.3 s sample once under-recorded ingest throughput 11×, because
+    an active in-process jax runtime steals the one CPU core in bursts).
+    Runs ``sample() -> rate`` ``trials`` times and reports the best (the
+    least-interfered run is closest to the machine's capability), the
+    max/min spread, and a ``host_suspect`` flag when the spread exceeds 2×
+    — the round-over-round comparability signal."""
+    rates = [float(sample()) for _ in range(trials)]
+    best, worst = max(rates), min(rates)
+    spread = best / max(worst, 1e-9)
+    return {
+        "best": round(best, 1),
+        "trials": trials,
+        "spread": round(spread, 2),
+        "host_suspect": bool(spread > 2.0),
+    }
+
+
 def measure_config5(rows: int = 65536, d: int = 4096, k: int = 256,
                     n_tokens: int = 2_000_000, steps: int = 16) -> dict:
     """Config-5 throughputs (SURVEY.md §1: streaming TF-IDF hashing).
@@ -174,15 +195,32 @@ def measure_config5(rows: int = 65536, d: int = 4096, k: int = 256,
     from randomprojection_tpu.models.sketch import CountSketch
     from randomprojection_tpu.ops.hashing import FeatureHasher
 
+    import os
+
     rng = np.random.default_rng(0)
     words = np.asarray([f"tok{i}" for i in range(50_000)])
     toks = words[rng.integers(0, len(words), size=n_tokens)]
     indptr = np.arange(0, n_tokens + 1, 100, dtype=np.int64)
     fh = FeatureHasher(n_features=1 << 20, input_type="string")
     fh.transform_tokens(toks[:1000])  # warm: builds the .so on first use
-    t0 = time.perf_counter()
-    fh.transform_tokens(toks, indptr)
-    ingest = n_tokens / (time.perf_counter() - t0)
+
+    def ingest_sample():
+        t0 = time.perf_counter()
+        fh.transform_tokens(toks, indptr)
+        return n_tokens / (time.perf_counter() - t0)
+
+    # serial hashing pinned for run-to-run comparability on this 1-core box
+    # (the C++ kernel reads the env per call); best-of-N guards against the
+    # in-process jax runtime stealing the core mid-sample
+    prev = os.environ.get("RP_HASH_THREADS")
+    os.environ["RP_HASH_THREADS"] = "1"
+    try:
+        ingest_stats = _host_best_of(ingest_sample)
+    finally:
+        if prev is None:
+            os.environ.pop("RP_HASH_THREADS", None)
+        else:
+            os.environ["RP_HASH_THREADS"] = prev
 
     import jax
 
@@ -198,12 +236,33 @@ def measure_config5(rows: int = 65536, d: int = 4096, k: int = 256,
         "onehot_split2" if 2 * k * d <= cs._MXU_MASK_BYTES_CAP else "scatter"
     )
     return {
-        "ingest_tokens_per_s": round(ingest, 1),
+        "ingest_tokens_per_s": ingest_stats["best"],
+        "ingest_trial_spread": ingest_stats["spread"],
+        "ingest_host_suspect": ingest_stats["host_suspect"],
+        "ingest_hash_threads": 1,
         "countsketch_rows_per_s": round(sketch, 1),
         "countsketch_kernel": kernel,
         "hash_space": 1 << 20,
         "sketch_shape": [d, k],
     }
+
+
+def harness_fold_cols(d: int) -> int:
+    """Columns mutated by the per-step fold: ``d/32``, at least 64."""
+    return max(64, d // 32)
+
+
+def harness_hbm_cap_rows_per_s(d: int, k: int, in_itemsize: int = 4) -> float:
+    """The harness's own HBM ceiling at 819 GB/s (v5e spec): per step the
+    kernel reads x once, writes y, and the fold reads+writes ``fold_cols``
+    columns.  A measured rate can approach but not exceed this — report it
+    next to every mode so the reader can tell "kernel slow" from "harness
+    at its own roofline"."""
+    bytes_per_row = (
+        d * in_itemsize + k * 4
+        + 2 * min(harness_fold_cols(d), d) * in_itemsize
+    )
+    return 819e9 / bytes_per_row
 
 
 def _scan_harness(jax, jnp, project, x0, steps, calls):
@@ -213,16 +272,30 @@ def _scan_harness(jax, jnp, project, x0, steps, calls):
     observed serving repeated calls from a cache):
 
     - every timed call sees DISTINCT argument values: the call index is
-      folded into the input on device (one buffer, no extra HBM);
+      folded into the whole input on device (one buffer, no extra HBM);
     - a scalar carry from call ``i``'s checksum is folded into call
       ``i+1``'s input, serializing the calls;
-    - within a call, scan steps chain through the input (defeats DCE).
+    - within a call, scan steps chain through the input (defeats DCE and
+      loop-invariant hoisting of the projection).
+
+    The per-step fold mutates only the first ``harness_fold_cols(d)``
+    columns (round-4 finding): scan steps inside one compiled dispatch
+    cannot be cache-served — the call-level defenses carry the anti-cache
+    burden — so the fold only needs to make x step-distinct.  The original
+    full-buffer fold read+wrote all of x every step, tripling HBM traffic
+    and capping the measurable rate at ~1/3 of the data-resident roofline
+    (r3's "22% of MXU peak" was this harness artifact, not the kernel).
+    A too-small fold (1 element) has been observed tripping the tunnel's
+    capricious call cache; d/32 columns (≥1 MB/step at bench shapes) has
+    not, and the ``timing_suspect`` >2×-peak check guards regressions.
 
     ``project(x) -> (n, k')`` may return any dtype (sign codes are uint8);
     the chain casts through f32.  Callers cross-check the resulting rate
     against the hardware peak (``executed_tflops`` / ``timing_suspect``).
     """
     import time as _time
+
+    fold_cols = min(harness_fold_cols(x0.shape[1]), x0.shape[1])
 
     @jax.jit
     def run_steps(x, carry, call_idx):
@@ -233,7 +306,10 @@ def _scan_harness(jax, jnp, project, x0, steps, calls):
 
         def step(x, _):
             y = project(x)
-            x = x + (y[:, :1].astype(jnp.float32) * 1e-24).astype(x.dtype)
+            upd = x[:, :fold_cols] + (
+                y[:, :1].astype(jnp.float32) * 1e-24
+            ).astype(x.dtype)
+            x = jax.lax.dynamic_update_slice(x, upd, (0, 0))
             return x, y[0, 0].astype(jnp.float32)
 
         _, ys = jax.lax.scan(step, x, None, length=steps)
@@ -262,13 +338,18 @@ def measure_config1() -> dict:
     X = rng.standard_normal((10_000, 512), dtype=np.float32)
     est = GaussianRandomProjection(64, random_state=0, backend="numpy").fit(X)
     est.transform(X[:100])  # warm BLAS
-    t0 = time.perf_counter()
-    est.transform(X)
-    dt = time.perf_counter() - t0
+
+    def sample():
+        t0 = time.perf_counter()
+        est.transform(X)
+        return 10_000 / (time.perf_counter() - t0)
+
+    stats = _host_best_of(sample)
     return {
         "workload": "gaussian 10000x512->64, numpy backend (CPU reference)",
-        "rows_per_s": round(10_000 / dt, 1),
-        "elapsed_s": round(dt, 4),
+        "rows_per_s": stats["best"],
+        "trial_spread": stats["spread"],
+        "host_suspect": stats["host_suspect"],
     }
 
 
@@ -318,6 +399,8 @@ def measure_config3(preset: str = "full") -> dict:
         "elapsed_s": round(elapsed, 4),
         "rows_timed": cfg["batch"] * cfg["steps"] * cfg["calls"],
         "executed_tflops": round(executed, 1),
+        "mxu_utilization": round(executed / V5E_PEAK_TFLOPS, 3),
+        "harness_hbm_cap_rows_per_s": round(harness_hbm_cap_rows_per_s(d, k), 1),
         "timing_suspect": bool(executed > 2 * V5E_PEAK_TFLOPS),
         "checksum": checksum,
     }
@@ -372,6 +455,7 @@ def measure_config4(preset: str = "full") -> dict:
         "elapsed_s": round(elapsed, 4),
         "rows_timed": cfg["batch"] * cfg["steps"] * cfg["calls"],
         "executed_tflops": round(executed, 1),
+        "mxu_utilization": round(executed / V5E_PEAK_TFLOPS, 3),
         "timing_suspect": bool(executed > 2 * V5E_PEAK_TFLOPS),
         "checksum": checksum,
         "code_bytes_per_row": k // 8,
@@ -398,7 +482,8 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
     # contraction, split2 runs it twice, 'high' three times — the peak
     # check must use what the hardware actually executes
     mxu_passes = {"bf16": 1, "bf16_split2": 2, "f32_high": 3,
-                  "lazy": 1, "lazy_split2": 2}
+                  "lazy": 1, "lazy_split2": 2, "lazy_bf16": 1}
+    in_itemsize = {"bf16": 2, "lazy_bf16": 2}  # default 4 (f32 input)
 
     # the fused lazy Pallas modes regenerate the mask in VMEM (zero R HBM
     # traffic — ops/pallas_kernels.py); the pltpu PRNG has no CPU or GPU
@@ -414,7 +499,7 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
 
         lazy_seed = 0
         R_lazy = pallas_sparse_matrix(lazy_seed, k, d, density)
-        for name in ("lazy", "lazy_split2"):
+        for name in ("lazy", "lazy_split2", "lazy_bf16"):
             mode_names.append(name)
             lazy_kw[name] = dict(k=k, density=density, lazy_seed=lazy_seed)
             R_by_mode[name] = R_lazy
@@ -432,6 +517,12 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
         nominal = perf["rows_per_s"] * 2 * d * k / 1e12
         perf["implied_tflops"] = round(nominal, 1)
         perf["executed_tflops"] = round(nominal * mxu_passes[name], 1)
+        perf["mxu_utilization"] = round(
+            perf["executed_tflops"] / V5E_PEAK_TFLOPS, 3
+        )
+        perf["harness_hbm_cap_rows_per_s"] = round(
+            harness_hbm_cap_rows_per_s(d, k, in_itemsize.get(name, 4)), 1
+        )
         perf["timing_suspect"] = bool(
             perf["executed_tflops"] > 2 * V5E_PEAK_TFLOPS
         )
@@ -469,6 +560,8 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
                 "elapsed_s": round(r["elapsed_s"], 4),
                 "implied_tflops": r["implied_tflops"],
                 "executed_tflops": r["executed_tflops"],
+                "mxu_utilization": r["mxu_utilization"],
+                "harness_hbm_cap_rows_per_s": r["harness_hbm_cap_rows_per_s"],
                 "timing_suspect": r["timing_suspect"],
             }
             for n, r in results.items()
