@@ -178,35 +178,47 @@ def _host_best_of(sample, trials: int = 3):
     }
 
 
-def measure_config5(rows: int = 65536, d: int = 4096, k: int = 256,
-                    n_tokens: int = 2_000_000, steps: int = 16) -> dict:
-    """Config-5 throughputs (SURVEY.md §1: streaming TF-IDF hashing).
+def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
+                    k: int = 256, steps: int = 16) -> dict:
+    """Config-5 throughputs (SURVEY.md §1: streaming TF-IDF hashing), all
+    at the stated ``hash_space = 2^20`` — the sketch runs ON DEVICE via the
+    CSR gather/scatter path (``models/sketch.py::_transform_csr_jax``; no
+    one-hot can exist at d=2^20).
 
     - ``ingest_tokens_per_s``: host feature-hashing of a flat token column
-      through the vectorized ``transform_tokens`` path (C++ murmur3, one
-      FFI call per batch).
-    - ``countsketch_rows_per_s``: the device CountSketch kernel (MXU
-      one-hot split2), data-resident like the headline modes — streamed
-      feeding is a separate, PCIe-bound number (SURVEY.md §7 R3; on this
-      tunneled dev chip host transfers measure the tunnel, not the chip).
+      (C++ murmur3, one FFI call per batch), best-of-3.
+    - ``device_sketch_docs_per_s``: the device hot loop alone, tokens
+      resident, through the anti-cache scan harness (this box's call cache
+      serves naive repeat timings — BASELINE.md).  Cross-checked against
+      the scatter's own HBM roofline (``sketch_hbm_cap_docs_per_s``).
+    - ``end_to_end_docs_per_s``: THE pipeline number — raw tokens →
+      murmur3 CSR → device sketch through ``TokenSource`` +
+      ``transform_stream`` (overlapped batches), wall-clock including all
+      hashing and transfers.  On this 1-core box it is ingest-bound by
+      construction; the components above attribute the gap.
     """
+    import os
+
+    import jax
     import jax.numpy as jnp
 
     from randomprojection_tpu.models.sketch import CountSketch
-    from randomprojection_tpu.ops.hashing import FeatureHasher
+    from randomprojection_tpu.ops.hashing import FeatureHasher, hash_tokens
+    from randomprojection_tpu.streaming import TokenSource
 
-    import os
-
+    d = 1 << 20
+    n_tokens = n_docs * tok_per_doc
     rng = np.random.default_rng(0)
     words = np.asarray([f"tok{i}" for i in range(50_000)])
     toks = words[rng.integers(0, len(words), size=n_tokens)]
-    indptr = np.arange(0, n_tokens + 1, 100, dtype=np.int64)
-    fh = FeatureHasher(n_features=1 << 20, input_type="string")
+    fh = FeatureHasher(n_features=d, input_type="string", dtype=np.float32)
     fh.transform_tokens(toks[:1000])  # warm: builds the .so on first use
 
     def ingest_sample():
         t0 = time.perf_counter()
-        fh.transform_tokens(toks, indptr)
+        fh.transform_tokens(
+            toks, np.arange(0, n_tokens + 1, tok_per_doc, dtype=np.int64)
+        )
         return n_tokens / (time.perf_counter() - t0)
 
     # serial hashing pinned for run-to-run comparability on this 1-core box
@@ -216,34 +228,90 @@ def measure_config5(rows: int = 65536, d: int = 4096, k: int = 256,
     os.environ["RP_HASH_THREADS"] = "1"
     try:
         ingest_stats = _host_best_of(ingest_sample)
+
+        # --- device hot loop, tokens resident, anti-cache scan harness ---
+        cs = CountSketch(k, random_state=0, backend="jax").fit_schema(
+            n_docs, d, np.float32
+        )
+        h_dev, s_dev = cs._device_tables()
+        rows = jnp.asarray(
+            np.repeat(np.arange(n_docs, dtype=np.int32), tok_per_doc)
+        )
+        idx0, _ = hash_tokens(toks, d)
+        idx = jnp.asarray(idx0)
+        vals0 = jnp.asarray(
+            rng.standard_normal(n_tokens, dtype=np.float32).reshape(
+                n_docs, tok_per_doc
+            )
+        )
+
+        def project(v):
+            # v (n_docs, tok_per_doc): the doc-major value layout lets the
+            # harness fold its carry per doc row; the scatter sees the
+            # flat token stream.  z is a data-dependent zero (v is the
+            # scan carry): with constant idx/rows XLA would hoist the
+            # per-token gathers and index arithmetic out of the scan,
+            # timing a gather-free loop that real streaming (fresh tokens
+            # every batch) never sees.
+            z = (v[0, 0] * 1e-30).astype(jnp.int32)
+            flat = (rows + z) * k + h_dev[idx + z]
+            y = jnp.zeros((n_docs * k,), jnp.float32)
+            return y.at[flat].add(
+                v.reshape(-1) * s_dev[idx + z].astype(jnp.float32)
+            ).reshape(n_docs, k)
+
+        calls = 3
+        docs_per_s, elapsed, _ = _scan_harness(
+            jax, jnp, project, vals0, steps, calls
+        )
+        # scatter HBM floor per step: per token read rows+idx (8B), gather
+        # h (4B) + s (1B) at random offsets, read vals (4B); RMW y once
+        # (8B/element); plus the harness fold's own read+write of
+        # fold_cols value columns per doc (the 64-col floor dominates
+        # tok_per_doc/32 at default widths)
+        fold_cols = min(harness_fold_cols(tok_per_doc), tok_per_doc)
+        step_bytes = (
+            n_tokens * (4 + 4 + 4 + 1 + 4)
+            + n_docs * k * 8
+            + n_docs * 2 * fold_cols * 4
+        )
+        cap_docs = 819e9 / (step_bytes / n_docs)
+
+        # --- the ONE pipeline number: tokens -> CSR -> device sketch ----
+        def read_tokens(lo, hi):
+            t = toks[lo * tok_per_doc : hi * tok_per_doc]
+            return t, np.arange(
+                0, (hi - lo) * tok_per_doc + 1, tok_per_doc, dtype=np.int64
+            )
+
+        source = TokenSource(read_tokens, n_docs, fh, batch_rows=8192)
+        est = CountSketch(k, random_state=0, backend="jax").fit_source(source)
+        for _, _y in est.transform_stream(source):  # warm compile, 1 batch
+            break
+        t0 = time.perf_counter()
+        n_done = 0
+        for _lo, y in est.transform_stream(source):
+            n_done += y.shape[0]
+        e2e = n_done / (time.perf_counter() - t0)
     finally:
         if prev is None:
             os.environ.pop("RP_HASH_THREADS", None)
         else:
             os.environ["RP_HASH_THREADS"] = prev
 
-    import jax
-
-    cs = CountSketch(k, random_state=0, backend="jax").fit_schema(
-        rows, d, np.float32
-    )
-    X = rng.standard_normal(size=(rows, d), dtype=np.float32)
-    cs._transform_dense_jax(X[:8])  # builds cs._jax_fn
-    fn = cs._jax_fn
-    calls = 3
-    sketch, _, _ = _scan_harness(jax, jnp, fn, jnp.asarray(X), steps, calls)
-    kernel = (
-        "onehot_split2" if 2 * k * d <= cs._MXU_MASK_BYTES_CAP else "scatter"
-    )
     return {
         "ingest_tokens_per_s": ingest_stats["best"],
         "ingest_trial_spread": ingest_stats["spread"],
         "ingest_host_suspect": ingest_stats["host_suspect"],
         "ingest_hash_threads": 1,
-        "countsketch_rows_per_s": round(sketch, 1),
-        "countsketch_kernel": kernel,
-        "hash_space": 1 << 20,
-        "sketch_shape": [d, k],
+        "device_sketch_docs_per_s": round(docs_per_s, 1),
+        "sketch_hbm_cap_docs_per_s": round(cap_docs, 1),
+        "sketch_timing_suspect": bool(docs_per_s > 2 * cap_docs),
+        "end_to_end_docs_per_s": round(e2e, 1),
+        "tokens_per_doc": tok_per_doc,
+        "hash_space": d,
+        "sketch_k": k,
+        "countsketch_kernel": "csr_gather_scatter",
     }
 
 
@@ -584,7 +652,7 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
         "config5": (
             measure_config5()
             if preset == "full"
-            else measure_config5(rows=8192, steps=4)
+            else measure_config5(n_docs=8192, steps=4)
         ),
     }
 

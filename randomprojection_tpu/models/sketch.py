@@ -208,8 +208,11 @@ class CountSketch(ParamsMixin):
     Dense f32 inputs on the jax backend run on the MXU as a one-hot ±1
     matmul (split-precision, see ``_transform_dense_jax`` for the measured
     kernel bake-off) with a device scatter-add fallback when the one-hot
-    matrix would be too large; sparse CSR inputs use a vectorized host
-    scatter (the Cython ``FeatureHasher`` fast path's role — sklearn
+    matrix would be too large.  Sparse CSR f32 inputs run ON DEVICE as a
+    gather + scatter-add against resident ``h_``/``s_`` tables
+    (``_transform_csr_jax`` — the config-5 hot loop at ``d=2^20``, where
+    no one-hot could fit); f64 CSR uses a vectorized host scatter (the
+    Cython ``FeatureHasher`` fast path's role — sklearn
     ``_hashing_fast.pyx``).
     """
 
@@ -266,6 +269,8 @@ class CountSketch(ParamsMixin):
             )
         self.__dict__.pop("_jax_fn", None)
         self.__dict__.pop("_slice_fns", None)
+        self.__dict__.pop("_csr_fns", None)
+        self.__dict__.pop("_dev_tables", None)
 
     def set_params(self, **params):
         super().set_params(**params)
@@ -291,7 +296,15 @@ class CountSketch(ParamsMixin):
                     "use_mxu=True cannot serve sparse input (the MXU path "
                     "is dense-only); densify X or use use_mxu=None"
                 )
-            return self._transform_csr(X.tocsr())
+            X = X.tocsr()
+            if X.shape[1] != self.n_features_in_:
+                raise ValueError(
+                    f"X has {X.shape[1]} features, expected "
+                    f"{self.n_features_in_}"
+                )
+            if self._csr_on_device(X):
+                return self._transform_csr_jax(X)
+            return self._transform_csr(X)
         X = check_array(X, accept_sparse=False)
         if X.shape[1] != self.n_features_in_:
             raise ValueError(
@@ -408,6 +421,139 @@ class CountSketch(ParamsMixin):
             return np.asarray(y)
         return y  # lazy device handle: the stream pipeline fetches later
 
+    def _csr_on_device(self, X) -> bool:
+        """Device CSR eligibility: jax path, f32 data (f64 stays on host by
+        the same truncation policy as the dense path), and a flat scatter
+        index that fits int32 (jax x64 is off; a batch would need >8M rows
+        at k=256 to overflow — far past any streaming batch size)."""
+        return (
+            self._use_jax
+            and X.dtype == np.float32
+            and X.shape[0] * self.n_components_ < 2**31
+        )
+
+    def _device_tables(self):
+        """``h_``/``s_`` resident on device (4+1 MB at d=2^20), uploaded
+        once per fit — per-batch traffic is only the batch's own tokens."""
+        t = self.__dict__.get("_dev_tables")
+        if t is None:
+            import jax.numpy as jnp
+
+            t = (jnp.asarray(self.h_), jnp.asarray(self.s_))
+            self.__dict__["_dev_tables"] = t
+        return t
+
+    def _transform_csr_jax(self, X, *, materialize: bool = True):
+        """Sketch a CSR batch ON DEVICE (config 5's hot loop — BL:11).
+
+        The 2^20-wide input space never materializes anywhere: per batch
+        the host ships only ``(row_ids, indices, data)`` (~12 bytes/token),
+        and the device gathers ``h_``/``s_`` from the resident tables and
+        scatter-adds into ``(n, k)``:
+
+            Y[row_t, h_[idx_t]] += s_[idx_t] · val_t
+
+        Static shapes for one-program streams: token count and row count
+        are padded on the octave ladder (``row_bucket``), pad tokens carry
+        value 0.  Under a mesh, rows shard over ``data_axis`` (DP): tokens
+        are partitioned at their shard's row boundaries on the host (CSR
+        ``indptr`` IS the partition), each shard scatters its own token
+        range into its own row block — zero collectives, same decomposition
+        as the dense path.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from randomprojection_tpu.parallel.sharded import (
+            row_bucket,
+            slice_rows_sharded,
+        )
+
+        n = X.shape[0]
+        k = self.n_components_
+        n_pad = row_bucket(max(n, 1), self.mesh, self.data_axis)
+        indptr = X.indptr.astype(np.int64, copy=False)
+        fns = self.__dict__.setdefault("_csr_fns", {})
+        h_dev, s_dev = self._device_tables()
+
+        def scatter_kernel(n_rows):
+            # the one device sketch body (shared by both branches): gather
+            # the resident tables at the batch's token indices, scatter-add
+            # into the flat (n_rows·k) accumulator
+            def body(rows, idx, vals, h, s):
+                flat = rows * k + h[idx]
+                y = jnp.zeros((n_rows * k,), jnp.float32)
+                return y.at[flat].add(
+                    vals * s[idx].astype(jnp.float32)
+                ).reshape(n_rows, k)
+
+            return body
+
+        if self.mesh is None:
+            rows = np.repeat(
+                np.arange(n, dtype=np.int32), np.diff(indptr)
+            )
+            t_pad = row_bucket(max(X.nnz, 1))
+            pad = t_pad - X.nnz
+            rows = np.pad(rows, (0, pad))
+            idx = np.pad(X.indices.astype(np.int32, copy=False), (0, pad))
+            vals = np.pad(X.data, (0, pad))
+            fn = fns.get((n_pad, t_pad))
+            if fn is None:
+                fn = jax.jit(scatter_kernel(n_pad))
+                fns[(n_pad, t_pad)] = fn
+            y = fn(jnp.asarray(rows), jnp.asarray(idx), jnp.asarray(vals),
+                   h_dev, s_dev)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            p = self.mesh.shape[self.data_axis]
+            rps = n_pad // p  # rows per shard (row_bucket pads to 8p)
+            # shard s owns rows [s·rps, (s+1)·rps): its token range is
+            # indptr[lo]:indptr[hi] — the CSR layout is already partitioned
+            bounds = indptr[np.minimum(np.arange(p + 1) * rps, n)]
+            counts = np.diff(bounds)
+            t_pad = row_bucket(int(max(counts.max(), 1)))
+            rows_l = np.zeros((p, t_pad), dtype=np.int32)
+            idx_s = np.zeros((p, t_pad), dtype=np.int32)
+            vals_s = np.zeros((p, t_pad), dtype=np.float32)
+            row_sizes = np.diff(indptr)
+            for s in range(p):
+                lo, hi = int(bounds[s]), int(bounds[s + 1])
+                c = hi - lo
+                r0, r1 = s * rps, min((s + 1) * rps, n)
+                rows_l[s, :c] = np.repeat(
+                    np.arange(r1 - r0, dtype=np.int32), row_sizes[r0:r1]
+                )
+                idx_s[s, :c] = X.indices[lo:hi]
+                vals_s[s, :c] = X.data[lo:hi]
+            fn = fns.get((n_pad, t_pad, p))
+            if fn is None:
+                kernel = scatter_kernel(rps)
+
+                def shard_body(rows, idx, vals, h, s):
+                    # operands arrive (1, t_pad) per shard: squeeze, then
+                    # run the shared kernel on this shard's row block
+                    return kernel(rows[0], idx[0], vals[0], h, s)
+
+                da = self.data_axis
+                fn = jax.jit(
+                    jax.shard_map(
+                        shard_body, mesh=self.mesh,
+                        in_specs=(P(da, None),) * 3 + (P(), P()),
+                        out_specs=P(da, None),
+                    )
+                )
+                fns[(n_pad, t_pad, p)] = fn
+            y = fn(rows_l, idx_s, vals_s, h_dev, s_dev)
+        y = slice_rows_sharded(
+            y, n, self.mesh, self.data_axis,
+            cache=self.__dict__.setdefault("_slice_fns", {}),
+        )
+        if materialize:
+            return np.asarray(y)
+        return y
+
     def _transform_csr(self, X):
         if X.shape[1] != self.n_features_in_:
             raise ValueError(
@@ -438,17 +584,26 @@ class CountSketch(ParamsMixin):
 
     def _transform_async(self, X):
         """Streaming transform: returns a lazy device handle on the jax
-        dense-f32 path so the pipeline overlaps sketch batches (the host
-        paths — f64, sparse, numpy backend — stay synchronous)."""
+        dense-f32 and CSR-f32 paths so the pipeline overlaps sketch batches
+        (the host paths — f64, numpy backend — stay synchronous)."""
         self._check_is_fitted()
-        if not sp.issparse(X):
-            X = check_array(X, accept_sparse=False)
+        if sp.issparse(X):
+            X = X.tocsr()
             if X.shape[1] != self.n_features_in_:
                 raise ValueError(
-                    f"X has {X.shape[1]} features, expected {self.n_features_in_}"
+                    f"X has {X.shape[1]} features, expected "
+                    f"{self.n_features_in_}"
                 )
-            if self._use_jax and X.dtype != np.float64:
-                return self._transform_dense_jax(X, materialize=False)
+            if not self.use_mxu and self._csr_on_device(X):
+                return self._transform_csr_jax(X, materialize=False)
+            return self.transform(X)
+        X = check_array(X, accept_sparse=False)
+        if X.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"X has {X.shape[1]} features, expected {self.n_features_in_}"
+            )
+        if self._use_jax and X.dtype != np.float64:
+            return self._transform_dense_jax(X, materialize=False)
         return self.transform(X)
 
     def _stream_out_dtype(self):

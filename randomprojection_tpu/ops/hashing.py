@@ -229,16 +229,24 @@ class FeatureHasher:
     """
 
     def __init__(self, n_features: int = 2**20, *, input_type: str = "dict",
-                 alternate_sign: bool = True):
+                 alternate_sign: bool = True, dtype=np.float64):
         if not isinstance(n_features, numbers.Integral) or n_features <= 0:
             raise ValueError(f"n_features must be a positive int, got {n_features!r}")
         if input_type not in ("dict", "pair", "string"):
             raise ValueError(
                 f"input_type must be 'dict', 'pair' or 'string', got {input_type!r}"
             )
+        if np.dtype(dtype).kind != "f":
+            raise ValueError(
+                f"dtype must be a float dtype, got {np.dtype(dtype)!r}"
+            )
         self.n_features = int(n_features)
         self.input_type = input_type
         self.alternate_sign = alternate_sign
+        # sklearn FeatureHasher parity knob; float32 is what feeds the
+        # device CountSketch path without a cast (models/sketch.py keeps
+        # float64 sketches on host by dtype policy)
+        self.dtype = np.dtype(dtype)
 
     def transform(self, raw_X) -> sp.csr_array:
         tokens: list = []
@@ -298,9 +306,9 @@ class FeatureHasher:
     def _build_csr(self, tokens, indptr, values) -> sp.csr_array:
         idx, sign = hash_tokens(tokens, self.n_features)
         if values is None:
-            data = np.ones(len(idx), dtype=np.float64)
+            data = np.ones(len(idx), dtype=self.dtype)
         else:
-            data = np.asarray(values, dtype=np.float64)
+            data = np.asarray(values, dtype=self.dtype)
         if self.alternate_sign:
             data = data * sign
         # copy indptr: sum_duplicates rewrites the CSR arrays in place, and

@@ -36,6 +36,7 @@ __all__ = [
     "ArraySource",
     "CallableSource",
     "FaultInjectionSource",
+    "TokenSource",
     "StreamCursor",
     "stream_transform",
     "stream_to_array",
@@ -128,6 +129,50 @@ class CallableSource(RowBatchSource):
                 raise ValueError(
                     f"Source returned shape {batch.shape} for rows [{lo},{hi}); "
                     f"expected {(hi - lo, self.n_features)}"
+                )
+            yield lo, batch
+
+
+class TokenSource(RowBatchSource):
+    """Raw-token documents → hashed CSR batches (the config-5 pipeline).
+
+    ``read_tokens(lo, hi)`` returns the tokens of documents ``[lo, hi)`` as
+    ``(tokens, indptr)`` or ``(tokens, indptr, values)`` — ``tokens`` a flat
+    array/sequence, ``indptr`` LOCAL row pointers of length ``hi-lo+1``
+    (``indptr[0] == 0``).  Each batch is hashed by ``hasher``
+    (``ops.hashing.FeatureHasher``) into a CSR that downstream estimators
+    consume — composed with ``CountSketch.transform_stream`` this is
+    tokens → murmur3 (C++) → device gather/scatter sketch, one pipeline,
+    checkpoint/resume included (the cursor is rows of documents; resume
+    re-hashes from the document boundary, which is exact because
+    ``read_tokens`` is deterministic in ``(lo, hi)``).
+    """
+
+    def __init__(self, read_tokens: Callable, n_rows: int, hasher,
+                 batch_rows: int = 65536):
+        if batch_rows <= 0:
+            raise ValueError(f"batch_rows must be positive, got {batch_rows}")
+        self._read_tokens = read_tokens
+        self.hasher = hasher
+        self.batch_rows = batch_rows
+        self.n_rows = n_rows
+        self.n_features = hasher.n_features
+        self.dtype = np.dtype(hasher.dtype)
+
+    def iter_batches(self, start_row: int = 0):
+        _check_start_row(start_row, self.batch_rows, self.n_rows)
+        for lo in range(start_row, self.n_rows, self.batch_rows):
+            hi = min(lo + self.batch_rows, self.n_rows)
+            out = self._read_tokens(lo, hi)
+            tokens, indptr = out[0], out[1]
+            values = out[2] if len(out) > 2 else None
+            with annotate("rp:stream/hash_tokens"):
+                batch = self.hasher.transform_tokens(tokens, indptr, values)
+            if batch.shape != (hi - lo, self.n_features):
+                raise ValueError(
+                    f"read_tokens produced a {batch.shape} batch for rows "
+                    f"[{lo},{hi}); expected {(hi - lo, self.n_features)} — "
+                    "indptr must be local with indptr[0]=0"
                 )
             yield lo, batch
 

@@ -171,3 +171,19 @@ def test_threaded_hashing_bit_identical(monkeypatch):
     monkeypatch.setenv("RP_HASH_THREADS", "3")
     idxl, _ = hash_tokens(sub, 1 << 16)
     np.testing.assert_array_equal(idxl, idx1)
+
+
+def test_feature_hasher_dtype_param():
+    """dtype selects the CSR value dtype (sklearn FeatureHasher parity);
+    float32 is what feeds the device CountSketch path without a cast."""
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+
+    fh32 = FeatureHasher(1 << 10, input_type="string", dtype=np.float32)
+    X32 = fh32.transform_tokens(np.asarray(["a", "b", "a"]))
+    assert X32.dtype == np.float32
+    fh64 = FeatureHasher(1 << 10, input_type="string")
+    X64 = fh64.transform_tokens(np.asarray(["a", "b", "a"]))
+    assert X64.dtype == np.float64
+    np.testing.assert_array_equal(X32.toarray(), X64.toarray())
+    with pytest.raises(ValueError, match="dtype"):
+        FeatureHasher(16, dtype=np.int32)

@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+import scipy.sparse as sp
 
 from randomprojection_tpu.ops import kernels
 from randomprojection_tpu.parallel import (
@@ -299,3 +300,26 @@ def test_row_bucket_ladder():
     assert b % 6 == 0 and b >= 100
     # per-shard row counts keep the f32 sublane tiling on any mesh size
     assert (b // 6) % 8 == 0
+
+
+def test_countsketch_mesh_csr_matches_single_device(devices):
+    """DP CSR sketch: tokens partitioned at shard row boundaries, each
+    shard scatters its own range — must match the no-mesh device path and
+    the host scatter, including ragged n and uneven tokens per shard."""
+    from randomprojection_tpu import CountSketch
+    from randomprojection_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(101, 500)).astype(np.float32)
+    X[np.abs(X) < 1.0] = 0.0
+    X[:40] = 0.0  # shard imbalance: early shards carry almost no tokens
+    Xs = sp.csr_array(X)
+    mesh = make_mesh({"data": 8})
+    Ym = CountSketch(
+        32, random_state=0, backend="jax", mesh=mesh
+    ).fit(Xs).transform(Xs)
+    Y1 = CountSketch(32, random_state=0, backend="jax").fit(Xs).transform(Xs)
+    assert Ym.shape == (101, 32) and Ym.dtype == np.float32
+    np.testing.assert_allclose(Ym, Y1, rtol=1e-6, atol=1e-6)
+    Yn = CountSketch(32, random_state=0, backend="numpy").fit(Xs).transform(Xs)
+    np.testing.assert_allclose(Ym, Yn, rtol=2e-5, atol=2e-5)

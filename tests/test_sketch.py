@@ -224,3 +224,67 @@ def test_countsketch_use_mxu_refuses_host_fallbacks():
     Ys = auto.transform(X)
     Yn = CountSketch(16, random_state=0, backend="numpy").fit(X).transform(X)
     np.testing.assert_allclose(Ys, Yn, rtol=2e-5, atol=2e-5)
+
+
+def test_countsketch_csr_f32_on_device_matches_host():
+    """f32 CSR routes to the device gather/scatter path (the config-5 hot
+    loop); it must agree with the host scatter at f32 grade, including
+    empty rows and duplicate hashed columns within a row."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(37, 400)).astype(np.float32)
+    X[np.abs(X) < 1.2] = 0.0
+    X[5] = 0.0  # an empty row
+    Xs = sp.csr_array(X)
+    cs = CountSketch(32, random_state=0, backend="jax").fit(Xs)
+    Yd = cs.transform(Xs)
+    Yh = CountSketch(32, random_state=0, backend="numpy").fit(Xs).transform(Xs)
+    assert Yd.dtype == np.float32
+    np.testing.assert_allclose(Yd, Yh, rtol=2e-5, atol=2e-5)
+    # zero-nnz batch: all-zero sketch, right shape
+    empty = sp.csr_array(np.zeros((4, 400), dtype=np.float32))
+    np.testing.assert_array_equal(cs.transform(empty), np.zeros((4, 32)))
+
+
+def test_countsketch_csr_device_at_hashing_space_scale():
+    """d = 2^20 (the BL:11 hash space): the device CSR path must engage —
+    no one-hot matrix exists at this width — and decode correctly."""
+    d = 1 << 20
+    rng = np.random.default_rng(6)
+    nnz_per_row = 50
+    n = 16
+    indices = rng.integers(0, d, size=n * nnz_per_row).astype(np.int32)
+    data = rng.normal(size=n * nnz_per_row).astype(np.float32)
+    indptr = np.arange(0, n * nnz_per_row + 1, nnz_per_row)
+    Xs = sp.csr_array((data, indices, indptr), shape=(n, d))
+    cs = CountSketch(256, random_state=0, backend="jax").fit_schema(n, d)
+    Y = cs.transform(Xs)
+    assert Y.shape == (n, 256) and Y.dtype == np.float32
+    ref = CountSketch(256, random_state=0, backend="numpy").fit_schema(n, d)
+    np.testing.assert_allclose(Y, ref.transform(Xs), rtol=2e-5, atol=2e-5)
+
+
+def test_countsketch_csr_f64_stays_on_host_exact():
+    """f64 CSR keeps the host scatter (device would truncate): exact."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(20, 300))
+    X[np.abs(X) < 1.0] = 0.0
+    Xs = sp.csr_array(X)
+    cs = CountSketch(16, random_state=0, backend="jax").fit(Xs)
+    Y = cs.transform(Xs)
+    assert Y.dtype == np.float64
+    ref = CountSketch(16, random_state=0, backend="numpy").fit(Xs).transform(Xs)
+    np.testing.assert_allclose(Y, ref, rtol=1e-12)
+
+
+def test_countsketch_csr_async_returns_device_handle():
+    """CSR f32 batches stream lazily (device handle) like dense f32."""
+    import jax
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(32, 200)).astype(np.float32)
+    X[np.abs(X) < 1.0] = 0.0
+    Xs = sp.csr_array(X)
+    cs = CountSketch(16, random_state=0, backend="jax").fit(Xs)
+    y = cs._transform_async(Xs)
+    assert isinstance(y, jax.Array)
+    np.testing.assert_allclose(np.asarray(y), cs.transform(Xs), rtol=1e-6)

@@ -315,3 +315,54 @@ def test_memmap_resume_bf16(tmp_path):
     )
     with pytest.raises(ValueError, match="mix two projections"):
         stream_to_memmap(est32, src, out_path, checkpoint_path=ckpt)
+
+
+def test_token_source_end_to_end_pipeline(tmp_path):
+    """Config-5 pipeline: raw tokens -> murmur3 CSR -> CountSketch, one
+    stream with checkpoint/resume.  The streamed sketch must equal the
+    all-at-once hash+sketch, and a crash/resume must be bit-identical."""
+    from randomprojection_tpu.models.sketch import CountSketch
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+    from randomprojection_tpu.streaming import TokenSource, stream_to_array
+
+    n_docs, tok_per_doc = 257, 20
+    words = np.asarray([f"w{i}" for i in range(5000)])
+
+    def read_tokens(lo, hi):
+        # deterministic in (lo, hi): each doc's tokens derive from its id
+        rngs = [np.random.default_rng(1000 + i) for i in range(lo, hi)]
+        toks = np.concatenate(
+            [words[r.integers(0, len(words), size=tok_per_doc)] for r in rngs]
+        )
+        indptr = np.arange(0, (hi - lo) * tok_per_doc + 1, tok_per_doc)
+        return toks, indptr
+
+    hasher = FeatureHasher(1 << 16, input_type="string", dtype=np.float32)
+    source = TokenSource(read_tokens, n_docs, hasher, batch_rows=64)
+    cs = CountSketch(32, random_state=0, backend="jax").fit_source(source)
+    assert cs.n_features_in_ == 1 << 16
+    Y = stream_to_array(cs, source)
+    assert Y.shape == (n_docs, 32) and Y.dtype == np.float32
+
+    toks, indptr = read_tokens(0, n_docs)
+    ref = cs.transform(hasher.transform_tokens(toks, indptr))
+    np.testing.assert_allclose(Y, ref, rtol=2e-5, atol=2e-5)
+
+    # crash after 2 batches, resume from cursor: bit-identical
+    ckpt = str(tmp_path / "cursor.json")
+    src_fail = FaultInjectionSource(
+        TokenSource(read_tokens, n_docs, hasher, batch_rows=64), 2
+    )
+    got = []
+    with pytest.raises(FaultInjectionSource.InjectedFault):
+        for lo, y in stream_transform(cs, src_fail, checkpoint_path=ckpt):
+            got.append((lo, y))
+    committed = StreamCursor.load(ckpt).rows_done
+    assert committed == sum(y.shape[0] for _, y in got)
+    src_fail.disarm()
+    for lo, y in stream_transform(cs, src_fail, checkpoint_path=ckpt):
+        assert lo == committed, "resume must continue at the cursor"
+        committed += y.shape[0]
+        got.append((lo, y))
+    Y2 = np.concatenate([y for _, y in got])
+    np.testing.assert_array_equal(Y2, Y)
