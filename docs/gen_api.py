@@ -48,15 +48,25 @@ def main():
                 sig(obj.__init__).replace("(self, ", "(").replace("(self)", "()")
             )
             lines += [f"### `{name}{init_sig}`", "", first_line(obj), ""]
-            methods = [
-                m for m in (
-                    "fit", "fit_schema", "fit_source", "transform",
-                    "fit_transform", "transform_stream", "inverse_transform",
-                    "get_feature_names_out", "get_params", "set_params",
-                    "components_as_numpy",
-                )
-                if callable(getattr(obj, m, None))
-            ]
+            # estimators document the canonical protocol order; other
+            # classes (e.g. SimHashIndex) list every public method so new
+            # surfaces can't silently vanish from the doc
+            estimator_protocol = (
+                "fit", "fit_schema", "fit_source", "transform",
+                "fit_transform", "transform_stream", "inverse_transform",
+                "get_feature_names_out", "get_params", "set_params",
+                "components_as_numpy",
+            )
+            if any(callable(getattr(obj, m, None)) for m in ("fit", "transform")):
+                methods = [
+                    m for m in estimator_protocol
+                    if callable(getattr(obj, m, None))
+                ]
+            else:
+                methods = [
+                    m for m, v in sorted(vars(obj).items())
+                    if not m.startswith("_") and callable(v)
+                ]
             if methods:
                 lines += ["Methods: " + ", ".join(f"`{m}`" for m in methods), ""]
         elif callable(obj):

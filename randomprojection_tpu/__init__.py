@@ -26,6 +26,7 @@ _LAZY_ESTIMATORS = (
     "SparseRandomProjection",
     "SignRandomProjection",
     "CountSketch",
+    "SimHashIndex",
     "pairwise_hamming",
     "pairwise_hamming_device",
     "pairwise_hamming_sharded",

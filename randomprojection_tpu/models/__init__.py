@@ -12,6 +12,7 @@ from randomprojection_tpu.models.projections import (
 )
 from randomprojection_tpu.models.sketch import (
     CountSketch,
+    SimHashIndex,
     SignRandomProjection,
     cosine_from_hamming,
     pairwise_hamming,
@@ -25,6 +26,7 @@ __all__ = [
     "SparseRandomProjection",
     "SignRandomProjection",
     "CountSketch",
+    "SimHashIndex",
     "pairwise_hamming",
     "pairwise_hamming_device",
     "pairwise_hamming_sharded",

@@ -34,6 +34,7 @@ from randomprojection_tpu.utils.validation import NotFittedError, check_array
 __all__ = [
     "SignRandomProjection",
     "CountSketch",
+    "SimHashIndex",
     "pairwise_hamming",
     "pairwise_hamming_device",
     "pairwise_hamming_sharded",
@@ -128,24 +129,14 @@ def pairwise_hamming_device(A, B=None, *, tile: int = 2048):
     """Device bulk Hamming: XOR + ``lax.population_count``, tiled over A.
 
     ``A (n1, nbytes)`` uint8 vs ``B (n2, nbytes)`` → ``(n1, n2)`` int32.
-    ``B`` is held on device whole and the dense output is allocated on the
-    host, so this serves query batches against an index that fits HBM
-    (n2·nbytes ≲ GBs) with n1 arbitrarily large via ``tile``.  For an index
-    beyond one chip's HBM, use ``pairwise_hamming_sharded`` (B row-sharded
-    over a mesh); this function is its per-shard primitive.
+    One-shot convenience over ``SimHashIndex`` (which holds ``B`` resident
+    across calls — use it directly when querying repeatedly): serves query
+    batches against an index that fits HBM (n2·nbytes ≲ GBs) with n1
+    arbitrarily large via ``tile``.  For an index beyond one chip's HBM,
+    use ``pairwise_hamming_sharded`` / ``SimHashIndex(mesh=...)``.
     """
-    import jax.numpy as jnp
-
     A = np.asarray(A, dtype=np.uint8)
-    B = A if B is None else np.asarray(B, dtype=np.uint8)
-    b_dev = jnp.asarray(B)
-    tile_fn = _hamming_tile_fn()
-
-    out = np.empty((A.shape[0], B.shape[0]), dtype=np.int32)
-    for lo in range(0, A.shape[0], tile):
-        hi = min(lo + tile, A.shape[0])
-        out[lo:hi] = np.asarray(tile_fn(jnp.asarray(A[lo:hi]), b_dev))
-    return out
+    return SimHashIndex(A if B is None else B).query(A, tile=tile)
 
 
 def pairwise_hamming_sharded(A, B=None, *, mesh, data_axis: str = "data",
@@ -159,38 +150,142 @@ def pairwise_hamming_sharded(A, B=None, *, mesh, data_axis: str = "data",
     the host with zero collectives (the output's column blocks ARE the
     shards).  Queries ``A`` stream through in ``tile``-row chunks,
     replicated to all devices.
+
+    One-shot convenience: each call pads and re-ships ``B``.  For repeated
+    queries construct ``SimHashIndex(B, mesh=mesh)`` once and reuse it —
+    this function is that, inlined.
     """
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     A = np.asarray(A, dtype=np.uint8)
-    B = A if B is None else np.asarray(B, dtype=np.uint8)
-    n2 = B.shape[0]
-    p = mesh.shape[data_axis]
-    pad = -n2 % p
-    b_dev = jax.device_put(
-        jnp.asarray(np.pad(B, ((0, pad), (0, 0)))),
-        NamedSharding(mesh, P(data_axis, None)),
-    )
-
-    fn = jax.jit(
-        jax.shard_map(
-            _hamming_counts, mesh=mesh,
-            in_specs=(P(), P(data_axis, None)),
-            out_specs=P(None, data_axis),
-        )
-    )
-    out = np.empty((A.shape[0], n2), dtype=np.int32)
-    for lo in range(0, A.shape[0], tile):
-        hi = min(lo + tile, A.shape[0])
-        out[lo:hi] = np.asarray(fn(jnp.asarray(A[lo:hi]), b_dev))[:, :n2]
-    return out
+    return SimHashIndex(
+        A if B is None else B, mesh=mesh, data_axis=data_axis
+    ).query(A, tile=tile)
 
 
 def cosine_from_hamming(hamming, n_bits: int):
     """SimHash estimate: ``cos(π · hamming / k)`` (Charikar 2002)."""
     return np.cos(np.pi * np.asarray(hamming, dtype=np.float64) / n_bits)
+
+
+class SimHashIndex:
+    """A persistent device-resident SimHash code index (config 4 serving).
+
+    ``pairwise_hamming_sharded`` is a per-call demo: it re-pads and
+    re-ships the whole index ``B`` to the device(s) on every call — at the
+    BL:10 scale (1B×32 B codes = 32 GB) that is a full-index host copy and
+    reshard per query batch.  This class is the serving primitive: the
+    codes are padded, uploaded, and (on a mesh) row-sharded ONCE at
+    construction; every ``query`` reuses the resident shards and ships
+    only the query tile, so steady-state traffic is queries + scores.
+
+    - ``mesh=None``: ``B`` lives whole on the default device (fits-HBM
+      regime of ``pairwise_hamming_device``).
+    - ``mesh=...``: ``B`` row-shards over ``data_axis``; each device scores
+      every query tile against its own shard and the ``(n1, n2)`` result
+      assembles on the host with zero collectives (the output's column
+      blocks ARE the shards).
+
+    ``add`` appends codes by rebuilding the resident array (bulk-build,
+    occasional append — the LSH-index usage); it is not a streaming
+    ingest path.
+    """
+
+    def __init__(self, codes, *, mesh=None, data_axis: str = "data",
+                 n_bits: Optional[int] = None):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        codes = np.asarray(codes, dtype=np.uint8)
+        if codes.ndim != 2:
+            raise ValueError(f"codes must be (n, nbytes), got {codes.shape}")
+        self.n_bytes = codes.shape[1]
+        # ragged k (e.g. 20 bits in 3 bytes): pad bits are zero in every
+        # code so they cancel in Hamming, but the cosine estimate must
+        # divide by the REAL bit count
+        self.n_bits = self.n_bytes * 8 if n_bits is None else int(n_bits)
+        if not 0 < self.n_bits <= self.n_bytes * 8:
+            raise ValueError(
+                f"n_bits={self.n_bits} outside (0, {self.n_bytes * 8}]"
+            )
+        self._host_codes = codes  # authoritative copy for add()
+        self._upload()
+
+    def _upload(self):
+        import jax
+        import jax.numpy as jnp
+
+        n = self._host_codes.shape[0]
+        self.n_codes = n
+        if self.mesh is None:
+            self._b_dev = jnp.asarray(self._host_codes)
+            self._pad = 0
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            p = self.mesh.shape[self.data_axis]
+            self._pad = -n % p
+            # host numpy straight into the sharded device_put: routing
+            # through jnp.asarray would materialize the WHOLE index on
+            # device 0 first — the all-to-device-0 hop, fatal at the
+            # beyond-one-HBM scale this class exists for
+            self._b_dev = jax.device_put(
+                np.pad(self._host_codes, ((0, self._pad), (0, 0))),
+                NamedSharding(self.mesh, P(self.data_axis, None)),
+            )
+        # no fn invalidation needed: jit retraces per shape on its own
+
+    def add(self, codes):
+        """Append codes (rebuild + re-upload; bulk usage, not streaming)."""
+        codes = np.asarray(codes, dtype=np.uint8)
+        if codes.ndim != 2 or codes.shape[1] != self.n_bytes:
+            raise ValueError(
+                f"codes must be (n, {self.n_bytes}), got {codes.shape}"
+            )
+        self._host_codes = np.concatenate([self._host_codes, codes])
+        self._upload()
+        return self
+
+    def _query_fn(self):
+        import jax
+
+        if self.mesh is None:
+            # the module-level jitted kernel, shared with
+            # pairwise_hamming_device — one compile cache for all indexes
+            return _hamming_tile_fn()
+        fn = self.__dict__.get("_fn")
+        if fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            fn = jax.jit(
+                jax.shard_map(
+                    _hamming_counts, mesh=self.mesh,
+                    in_specs=(P(), P(self.data_axis, None)),
+                    out_specs=P(None, self.data_axis),
+                )
+            )
+            self.__dict__["_fn"] = fn
+        return fn
+
+    def query(self, A, *, tile: int = 2048):
+        """Hamming distances ``(n_queries, n_codes)`` against the resident
+        index; only the query tiles cross the host↔device boundary."""
+        import jax.numpy as jnp
+
+        A = np.asarray(A, dtype=np.uint8)
+        if A.ndim != 2 or A.shape[1] != self.n_bytes:
+            raise ValueError(
+                f"queries must be (n, {self.n_bytes}), got {A.shape}"
+            )
+        fn = self._query_fn()
+        out = np.empty((A.shape[0], self.n_codes), dtype=np.int32)
+        for lo in range(0, A.shape[0], tile):
+            hi = min(lo + tile, A.shape[0])
+            out[lo:hi] = np.asarray(
+                fn(jnp.asarray(A[lo:hi]), self._b_dev)
+            )[:, : self.n_codes]
+        return out
+
+    def query_cosine(self, A, *, tile: int = 2048):
+        """SimHash cosine estimates against the resident index."""
+        return cosine_from_hamming(self.query(A, tile=tile), self.n_bits)
 
 
 class CountSketch(ParamsMixin):
@@ -409,10 +504,24 @@ class CountSketch(ParamsMixin):
         if not hasattr(self, "_jax_fn"):
             self._build_jax_fn(jax, jnp)
         n = X.shape[0]
-        x = jnp.asarray(X)
         pad_to = row_bucket(n, self.mesh, self.data_axis)
-        if pad_to != n:
-            x = jnp.pad(x, ((0, pad_to - n), (0, 0)))
+        if self.mesh is None:
+            x = jnp.asarray(X)
+            if pad_to != n:
+                x = jnp.pad(x, ((0, pad_to - n), (0, 0)))
+        else:
+            # pad on host and device_put ROW-SHARDED (the jax backend's
+            # _prepare_rows preamble): jnp.asarray would land the whole
+            # batch on device 0 and pay an extra all-to-device-0 hop per
+            # batch before the jitted shard_map reshards it
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            x = np.asarray(X)
+            if pad_to != n:
+                x = np.pad(x, ((0, pad_to - n), (0, 0)))
+            x = jax.device_put(
+                x, NamedSharding(self.mesh, P(self.data_axis, None))
+            )
         y = slice_rows_sharded(
             self._jax_fn(x), n, self.mesh, self.data_axis,
             cache=self.__dict__.setdefault("_slice_fns", {}),
