@@ -438,7 +438,6 @@ class JaxBackend(ProjectionBackend):
 
         from randomprojection_tpu.ops.pallas_kernels import (
             BLOCK_D,
-            BLOCK_N,
             fused_sparse_project,
         )
 
@@ -449,10 +448,10 @@ class JaxBackend(ProjectionBackend):
             in_specs = (P(data_axis, None),)
 
             def local(x):
+                # block_n=None: the kernel picks the largest VMEM-fitting
+                # row tile for this shard's row count
                 return fused_sparse_project(
-                    x, seed, k, density,
-                    block_n=min(BLOCK_N, max(8, x.shape[0])),
-                    mxu_mode=mxu_mode,
+                    x, seed, k, density, mxu_mode=mxu_mode,
                 )
 
         else:
@@ -464,7 +463,6 @@ class JaxBackend(ProjectionBackend):
                 )
                 partial = fused_sparse_project(
                     x, seed, k, density,
-                    block_n=min(BLOCK_N, max(8, x.shape[0])),
                     block_offset=offset,
                     mxu_mode=mxu_mode,
                 )
@@ -523,19 +521,16 @@ class JaxBackend(ProjectionBackend):
                 )
             else:
                 from randomprojection_tpu.ops.pallas_kernels import (
-                    BLOCK_N,
                     fused_sparse_project,
                 )
 
+                # block_n=None: the kernel's shape-aware auto tile (largest
+                # VMEM-fitting row tile, no re-padding of small batches)
                 y = fused_sparse_project(
                     xc,
                     state.seed,
                     spec.n_components,
                     state.density,
-                    # x is already row-bucketed (multiple of 8): matching
-                    # the kernel row tile avoids re-padding small batches to
-                    # BLOCK_N
-                    block_n=min(BLOCK_N, x.shape[0]),
                     mxu_mode=mxu_mode,
                 ).astype(x.dtype)
         else:

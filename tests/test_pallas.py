@@ -510,3 +510,37 @@ def test_lazy_streaming_matches_transform(tmp_path):
     StreamCursor(rows_done=256).save(ckpt)
     stream_to_memmap(est, ArraySource(X, 128), out_path, checkpoint_path=ckpt)
     np.testing.assert_array_equal(np.load(out_path), first)
+
+
+def test_auto_block_n_shape_aware():
+    """block_n=None resolves to the largest row tile that (a) fits scoped
+    VMEM (a 2048-row tile measurably exceeds Mosaic's limit; large k
+    shrinks the budget), (b) pads no extra rows vs the 256 baseline, and
+    (c) never starves a mask cache that is full at the baseline."""
+    from randomprojection_tpu.ops.pallas_kernels import (
+        _VMEM_LIMIT,
+        _auto_block_n,
+        _reserved_bytes,
+    )
+
+    # headline shapes: full cache at every tile -> largest wins
+    assert _auto_block_n(131072, 4096, 256, "split2") == 1024
+    assert _auto_block_n(131072, 4096, 256, "bf16") == 1024
+    assert _auto_block_n(131072, 4096, 256, "f32") == 1024
+    # k=2048: only the 256 tile fits VMEM
+    bn = _auto_block_n(131072, 4096, 2048, "f32")
+    assert bn == 256
+    assert _reserved_bytes(bn, 2048, "f32", 4) <= _VMEM_LIMIT
+    # small batches: one tile, no over-padding past the sublane multiple
+    assert _auto_block_n(100, 4096, 256, "f32") == 104
+    assert _auto_block_n(8, 4096, 256, "f32") == 8
+    # padding guard: bucketed row counts must not balloon (1280 is a real
+    # row_bucket output; 1024/512 would pad it to 2048/1536)
+    assert _auto_block_n(1280, 4096, 256, "f32") == 256
+    assert _auto_block_n(600, 4096, 256, "f32") == 256  # base pads to 768
+    # cache guard: k=512 d=4096 has a FULL 8-block cache at 256 but a
+    # starved one at 1024 -> settle on 512 (full cache, bigger tile)
+    assert _auto_block_n(131072, 4096, 512, "split2") == 512
+    # partial cache either way (d=16384: 32 blocks never fit) -> largest
+    # tile wins (measured faster: fewer grid rows regenerating)
+    assert _auto_block_n(16384, 16384, 512, "split2") == 1024
