@@ -1,10 +1,12 @@
 """Config 5 (BASELINE.json:11): Count-Sketch / feature hashing on streaming
-TF-IDF-style documents.
+TF-IDF-style documents — end to end ON DEVICE at the stated 2^20 space.
 
-Raw token dicts → C++ murmur3 ``FeatureHasher`` (2^18-dim CSR) →
-``CountSketch`` down to 256 dims, document stream processed in batches.
-The full-scale config is 100M docs; throughput here is hasher-bound on one
-core (the hasher is the native batch kernel in native/murmur3.cpp).
+Raw tokens → C++ murmur3 ``FeatureHasher`` (2^20-dim f32 CSR) →
+``CountSketch`` down to 256 dims on the chip (resident hash tables +
+gather/scatter-add; no one-hot matrix can exist at d=2^20), streamed as
+one resumable pipeline via ``TokenSource``.  The full-scale config is
+100M docs; throughput here is hasher-bound on one core (the hasher is
+the native batch kernel in native/murmur3.cpp).
 """
 
 import argparse
@@ -61,29 +63,43 @@ def main():
     sys.path.insert(0, ".")
     from randomprojection_tpu import CountSketch
     from randomprojection_tpu.ops.hashing import FeatureHasher
+    from randomprojection_tpu.streaming import TokenSource
 
     n_docs = 200_000 if args.scale == "full" else 10_000
-    hash_dim, k, batch = 2**18, 256, 2000
+    hash_dim, k, batch = 2**20, 256, 2000
 
+    # dtype=float32 ⇒ the sketch runs on device (CSR gather/scatter
+    # against resident h_/s_ tables); float64 (the default) would keep
+    # the exact host scatter
     hasher = FeatureHasher(
         n_features=hash_dim,
         input_type="dict" if args.ingest == "dict" else "string",
+        dtype=np.float32,
     )
-    cs = CountSketch(k, random_state=0).fit_schema(n_docs, hash_dim)
 
     t0 = time.perf_counter()
     done, checksum, tokens_seen = 0, 0.0, 0
-    while done < n_docs:
-        hi = min(done + batch, n_docs)
-        if args.ingest == "dict":
+    if args.ingest == "dict":
+        cs = CountSketch(k, random_state=0).fit_schema(n_docs, hash_dim)
+        while done < n_docs:
+            hi = min(done + batch, n_docs)
             X = hasher.transform(synth_docs(done, hi))  # CSR, hashed
-        else:
-            toks, indptr = synth_token_columns(done, hi)
+            Y = cs.transform(X)                         # (batch, k) sketch
+            checksum += float(np.abs(Y[0]).sum())
+            done = hi
+    else:
+        # the one-pipeline form: tokens → murmur3 → device sketch,
+        # checkpoint/resumable (pass checkpoint_path= to make it durable)
+        def read_tokens(lo, hi):
+            nonlocal tokens_seen
+            toks, indptr = synth_token_columns(lo, hi)
             tokens_seen += len(toks)
-            X = hasher.transform_tokens(toks, indptr)   # one FFI call
-        Y = cs.transform(X)                             # (batch, k) sketch
-        checksum += float(Y[0, 0])
-        done = hi
+            return toks, indptr
+
+        source = TokenSource(read_tokens, n_docs, hasher, batch_rows=batch)
+        cs = CountSketch(k, random_state=0).fit_source(source)
+        for _lo, Y in cs.transform_stream(source):
+            checksum += float(np.abs(Y[0]).sum())
     dt = time.perf_counter() - t0
     out = {
         "config": 5, "docs": n_docs, "hash_dim": hash_dim, "k": k,
@@ -93,9 +109,10 @@ def main():
     if tokens_seen:
         out["tokens_per_s"] = round(tokens_seen / dt, 1)
 
-    # On a multi-chip slice the DENSE sketch path (the MXU one-hot matmul)
-    # DP-shards rows over the mesh — the "100M docs on v5e-8" deployment
-    # shape.  (The CSR ingest above is the host scatter path either way.)
+    # On a multi-chip slice the sketch DP-shards rows over the mesh — the
+    # "100M docs on v5e-8" deployment shape.  (CSR batches shard too: the
+    # tokens partition at shard row boundaries; dense batches shown here
+    # use the MXU one-hot matmul per shard.)
     import jax
 
     if len(jax.devices()) > 1:
