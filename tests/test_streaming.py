@@ -366,3 +366,35 @@ def test_token_source_end_to_end_pipeline(tmp_path):
         got.append((lo, y))
     Y2 = np.concatenate([y for _, y in got])
     np.testing.assert_array_equal(Y2, Y)
+
+
+def test_token_source_validation_and_values():
+    """A reader returning mis-shaped batches must fail loudly (a silent
+    local/global indptr mix-up would mis-assign rows); weighted tokens
+    (TF-IDF values) flow through to the CSR."""
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+    from randomprojection_tpu.streaming import TokenSource
+
+    fh = FeatureHasher(1 << 10, input_type="string", dtype=np.float32)
+
+    def bad_reader(lo, hi):
+        # GLOBAL indptr (the classic mistake): indptr[0] == lo != 0, so
+        # transform_tokens refuses (only bit-identical to a local indptr
+        # for the lo == 0 batch — hence n_rows > batch_rows here)
+        toks = np.asarray(["a"] * (hi - lo))
+        return toks, np.arange(lo, hi + 1)
+
+    src = TokenSource(bad_reader, 8, fh, batch_rows=4)
+    with pytest.raises(ValueError):
+        list(src.iter_batches())
+
+    def weighted_reader(lo, hi):
+        toks = np.asarray(["w"] * (hi - lo))
+        indptr = np.arange(0, hi - lo + 1)
+        values = np.full(hi - lo, 2.5)
+        return toks, indptr, values
+
+    src = TokenSource(weighted_reader, 4, fh, batch_rows=4)
+    (lo, batch), = src.iter_batches()
+    assert batch.shape == (4, 1 << 10) and batch.dtype == np.float32
+    assert set(np.abs(batch.data)) == {2.5}  # weights survived hashing
