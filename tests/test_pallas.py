@@ -422,6 +422,39 @@ def test_lazy_dp_mesh_matches_single_device(x):
 
 
 @requires_tpu
+def test_lazy_bf16_mesh_matches_single_device():
+    """bf16-fitted lazy under a DP mesh routes mxu_mode='bf16' through the
+    shard_map'd kernel (the mesh-fn cache keys on the mode): result must
+    equal the no-mesh bf16 lazy path exactly."""
+    from randomprojection_tpu import SparseRandomProjection
+    from randomprojection_tpu.parallel import default_mesh
+    from randomprojection_tpu.utils.validation import bfloat16_dtype
+
+    bf16 = bfloat16_dtype()
+    if bf16 is None:
+        pytest.skip("ml_dtypes bfloat16 unavailable")
+    Xf = np.random.default_rng(6).normal(size=(128, 1024)).astype(np.float32)
+    X16 = Xf.astype(bf16)
+    common = dict(
+        n_components=32, density=1 / 3, random_state=9, backend="jax",
+    )
+    est_m = SparseRandomProjection(
+        **common,
+        backend_options={"mesh": default_mesh(), "materialization": "lazy"},
+    ).fit(X16)
+    # populate the mesh-fn cache with the f32-input mode FIRST: a cache
+    # key missing mxu_mode would hand the bf16 transform below the wrong
+    # shard_map fn
+    est_m.transform(Xf)
+    est_1 = SparseRandomProjection(
+        **common, backend_options={"materialization": "lazy"}
+    ).fit(X16)
+    Ym, Y1 = np.asarray(est_m.transform(X16)), np.asarray(est_1.transform(X16))
+    assert Ym.dtype == bf16
+    np.testing.assert_array_equal(Ym, Y1)
+
+
+@requires_tpu
 def test_lazy_tp_mesh_single_shard_matches():
     """The TP lazy code path (offset fold-in + psum) on however many real
     chips exist; with one feature shard the offset is zero and the result
