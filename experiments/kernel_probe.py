@@ -5,6 +5,11 @@ Measures, on the real chip, where fused_sparse_project's time goes:
 - a mask-free variant (constant mask, same dots) = matmul-only ceiling
 - a regen-once variant is approximated by the ratio of the two
 
+HISTORICAL: this probe predates the VMEM mask-block cache and the auto
+row tile that its constant-mask finding motivated (see
+ops/pallas_kernels.py round-4 comments and BASELINE.md for the outcome:
+mask machinery now costs ~7%, kernel at ~93% of its own dot ceiling).
+
 All numbers go through the bench's anti-cache scan harness; on this box
 wall-clock is dispatch-polluted, so only RELATIVE comparisons within one
 run are meaningful (BASELINE.md).  Run: python experiments/kernel_probe.py
