@@ -134,7 +134,10 @@ class CallableSource(RowBatchSource):
 
 
 class TokenSource(RowBatchSource):
-    """Raw-token documents → hashed CSR batches (the config-5 pipeline).
+    """Raw-token documents → hashed CSR batches (the config-5 pipeline,
+    BL:11 "streaming TF-IDF"; the hashing role sklearn implements in
+    ``feature_extraction/_hashing_fast.pyx``, here the C++ murmur3 batch
+    kernel feeding the device sketch).
 
     ``read_tokens(lo, hi)`` returns the tokens of documents ``[lo, hi)`` as
     ``(tokens, indptr)`` or ``(tokens, indptr, values)`` — ``tokens`` a flat
