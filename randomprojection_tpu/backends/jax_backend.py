@@ -417,7 +417,8 @@ class JaxBackend(ProjectionBackend):
         """
         return "f32" if self.precision == "default" else "split2"
 
-    def _get_lazy_mesh_fn(self, state, spec: ProjectionSpec, mxu_mode: str):
+    def _get_lazy_mesh_fn(self, state, spec: ProjectionSpec, mxu_mode: str,
+                          no_cache: bool = False):
         """shard_map'd fused lazy projection over the mesh.
 
         DP: each device runs the fused kernel on its row shard — the matrix
@@ -429,7 +430,9 @@ class JaxBackend(ProjectionBackend):
         feature axis completes the contraction — same collective budget as
         the dense TP path, still no R in HBM anywhere.
         """
-        cache_key = (state.seed, state.density, spec.n_components, mxu_mode)
+        cache_key = (
+            state.seed, state.density, spec.n_components, mxu_mode, no_cache
+        )
         fn = self._lazy_mesh_fns.get(cache_key)
         if fn is not None:
             return fn
@@ -452,6 +455,7 @@ class JaxBackend(ProjectionBackend):
                 # row tile for this shard's row count
                 return fused_sparse_project(
                     x, seed, k, density, mxu_mode=mxu_mode,
+                    no_cache=no_cache,
                 )
 
         else:
@@ -465,6 +469,7 @@ class JaxBackend(ProjectionBackend):
                     x, seed, k, density,
                     block_offset=offset,
                     mxu_mode=mxu_mode,
+                    no_cache=no_cache,
                 )
                 return jax.lax.psum(partial, feature_axis)
 
@@ -516,9 +521,31 @@ class JaxBackend(ProjectionBackend):
             else:
                 mxu_mode, xc = self._lazy_mxu_mode(), x.astype(jnp.float32)
             if self.mesh is not None:
-                y = self._get_lazy_mesh_fn(state, spec, mxu_mode)(xc).astype(
-                    x.dtype
-                )
+                try:
+                    y = self._get_lazy_mesh_fn(state, spec, mxu_mode)(xc)
+                except Exception as e:  # pragma: no cover — Mosaic VMEM OOM
+                    # the shard_map compiles outside fused_sparse_project's
+                    # own eager fallback frame, so the scoped-VMEM retry
+                    # (cache disabled = the documented regenerate-every-step
+                    # degeneration) lives at this call site
+                    from randomprojection_tpu.ops.pallas_kernels import (
+                        is_vmem_oom,
+                    )
+
+                    if not is_vmem_oom(e):
+                        raise
+                    fallback = self._get_lazy_mesh_fn(
+                        state, spec, mxu_mode, no_cache=True
+                    )
+                    # rebind the failing key so later batches of this model
+                    # go straight to the degeneration instead of repaying
+                    # the failed Mosaic compile every time
+                    self._lazy_mesh_fns[
+                        (state.seed, state.density, spec.n_components,
+                         mxu_mode, False)
+                    ] = fallback
+                    y = fallback(xc)
+                y = y.astype(x.dtype)
             else:
                 from randomprojection_tpu.ops.pallas_kernels import (
                     fused_sparse_project,

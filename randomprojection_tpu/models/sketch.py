@@ -533,12 +533,24 @@ class CountSketch(ParamsMixin):
     def _csr_on_device(self, X) -> bool:
         """Device CSR eligibility: jax path, f32 data (f64 stays on host by
         the same truncation policy as the dense path), and a flat scatter
-        index that fits int32 (jax x64 is off; a batch would need >8M rows
-        at k=256 to overflow — far past any streaming batch size)."""
+        index that fits int32 (jax x64 is off; a batch would need >6M rows
+        at k=256 to overflow — far past any streaming batch size).  The
+        guard uses the PADDED row count — ``_transform_csr_jax`` buckets
+        rows up to +25% (``row_bucket``), and the flat index spans
+        ``n_pad·k``, so guarding on the raw ``n`` would admit a narrow band
+        of batches that overflow after padding.  Under a mesh the scatter
+        accumulator is PER SHARD (``scatter_kernel(rps)``), so the guard
+        scales by the data-axis size — a batch the mesh path handles must
+        not be routed to the host fallback."""
+        from randomprojection_tpu.parallel.sharded import row_bucket
+
+        n_pad = row_bucket(max(X.shape[0], 1), self.mesh, self.data_axis)
+        if self.mesh is not None:
+            n_pad //= self.mesh.shape[self.data_axis]
         return (
             self._use_jax
             and X.dtype == np.float32
-            and X.shape[0] * self.n_components_ < 2**31
+            and n_pad * self.n_components_ < 2**31
         )
 
     def _device_tables(self):

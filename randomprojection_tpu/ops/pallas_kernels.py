@@ -237,12 +237,6 @@ def _matrix_kernel(seed_ref, o_ref, *, k, density, scale):
     o_ref[:] = _mask_block(density)((k, o_ref.shape[1])) * scale
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "seed", "n_components", "density", "block_n", "mxu_mode", "interpret",
-    ),
-)
 def fused_sparse_project(
     x,
     seed,
@@ -253,6 +247,7 @@ def fused_sparse_project(
     block_offset=0,
     mxu_mode: str = "f32",
     interpret: bool = False,
+    no_cache: bool = False,
 ):
     """``Y = X @ R(seed)ᵀ`` with ``R`` regenerated in-kernel, never in HBM.
 
@@ -284,7 +279,72 @@ def fused_sparse_project(
     - ``'bf16'``: X kept bfloat16 end-to-end (half the x HBM traffic — the
       mode for bf16-fitted models, where 1 exact-mask pass IS the data's
       own precision), 1 MXU pass, f32 accumulation.
+
+    VMEM-safety fallback: the mask-cache sizing relies on a measured 3 MiB
+    Mosaic-temporary headroom (``_VMEM_HEADROOM``).  Should an untested
+    ``(shape, block_n, k, mode)`` combination still blow the scoped-VMEM
+    limit at compile, an eager call retries once with the cache disabled
+    (the documented regenerate-every-step degeneration) and remembers the
+    failing key.  Traced callers compile outside this frame and cannot be
+    caught here — they opt into the degeneration explicitly with
+    ``no_cache=True`` after catching the failure at their own call site
+    (the mesh path: ``jax_backend._project_prepared``).  Cache presence
+    does not change values — the (seed, block) streams are identical
+    either way.
     """
+    # keyed by input shape too: the VMEM-feasible tile and cache sizing are
+    # resolved per (n, d) by _auto_block_n, so one failing exotic shape must
+    # not disable the cache for the (k, mode)'s healthy shapes
+    key = (tuple(x.shape), block_n, n_components, mxu_mode)
+    if not no_cache and key not in _NO_CACHE_KEYS:
+        try:
+            return _fused_impl(
+                x, seed, n_components, density, block_n=block_n,
+                block_offset=block_offset, mxu_mode=mxu_mode,
+                interpret=interpret, no_cache=False,
+            )
+        except Exception as e:  # pragma: no cover — needs a Mosaic VMEM OOM
+            if not is_vmem_oom(e):
+                raise
+            _NO_CACHE_KEYS.add(key)
+    return _fused_impl(
+        x, seed, n_components, density, block_n=block_n,
+        block_offset=block_offset, mxu_mode=mxu_mode,
+        interpret=interpret, no_cache=True,
+    )
+
+
+_NO_CACHE_KEYS: set = set()
+
+
+def is_vmem_oom(exc: Exception) -> bool:
+    """Classify a Mosaic scoped-VMEM exhaustion (the one failure the
+    no-cache degeneration can fix) — shared by the eager fallback above and
+    the mesh call site (``jax_backend._project_prepared``), so the two
+    paths cannot drift when an error wording changes."""
+    msg = str(exc).lower()
+    return "vmem" in msg or "scoped" in msg
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "seed", "n_components", "density", "block_n", "mxu_mode", "interpret",
+        "no_cache",
+    ),
+)
+def _fused_impl(
+    x,
+    seed,
+    n_components: int,
+    density: float,
+    *,
+    block_n: Optional[int],
+    block_offset,
+    mxu_mode: str,
+    interpret: bool,
+    no_cache: bool,
+):
     if mxu_mode not in ("f32", "split2", "bf16"):
         raise ValueError(
             f"mxu_mode must be 'f32', 'split2' or 'bf16', got {mxu_mode!r}"
@@ -338,7 +398,7 @@ def fused_sparse_project(
                 jnp.float32 if cache_itemsize == 4 else jnp.bfloat16,
             )
         ]
-        if max_slots > 0 and ni > 1
+        if max_slots > 0 and ni > 1 and not no_cache
         else []
     )
 

@@ -29,7 +29,7 @@ from typing import Callable, Iterator, Optional, Tuple
 import numpy as np
 import scipy.sparse as sp
 
-from randomprojection_tpu.utils.observability import annotate
+from randomprojection_tpu.utils.observability import annotate, batch_nbytes
 
 __all__ = [
     "RowBatchSource",
@@ -309,7 +309,7 @@ def stream_transform(
             y = estimator._transform_async(batch)
         # keep only the byte count: retaining the batch itself would pin
         # pipeline_depth extra input batches of host memory
-        pending.append((start_row, batch.shape[0], y, getattr(batch, "nbytes", 0)))
+        pending.append((start_row, batch.shape[0], y, batch_nbytes(batch)))
         if len(pending) >= pipeline_depth:
             yield from emit(pending.pop(0))
     while pending:

@@ -15,7 +15,32 @@ from typing import Optional
 
 logger = logging.getLogger("randomprojection_tpu")
 
-__all__ = ["StreamStats", "profile_trace", "annotate", "logger"]
+__all__ = ["StreamStats", "batch_nbytes", "profile_trace", "annotate", "logger"]
+
+
+def batch_nbytes(batch) -> int:
+    """Payload bytes of one (dense or scipy-sparse) batch.
+
+    scipy sparse carries its payload in per-format component arrays and
+    exposes no ``.nbytes`` itself — a bare ``getattr(batch, 'nbytes', 0)``
+    silently records 0 for every sparse stream.  CSR/CSC/BSR count
+    data+indices+indptr, COO data+coords (or row/col on pre-array scipy),
+    DIA data+offsets."""
+    import numpy as np
+    import scipy.sparse as sp
+
+    if not sp.issparse(batch):
+        return int(getattr(batch, "nbytes", 0))
+    data = getattr(batch, "data", None)
+    total = int(data.nbytes) if isinstance(data, np.ndarray) else 0
+    coords = getattr(batch, "coords", None)
+    if isinstance(coords, tuple):  # COO; .row/.col are views of .coords
+        return total + sum(int(c.nbytes) for c in coords)
+    for a in ("indices", "indptr", "row", "col", "offsets"):
+        v = getattr(batch, a, None)
+        if isinstance(v, np.ndarray):
+            total += int(v.nbytes)
+    return total
 
 
 class StreamStats:
@@ -50,7 +75,7 @@ class StreamStats:
         n = getattr(batch_out, "shape", (0,))[0]
         self.rows += n
         self.bytes_in += bytes_in
-        self.bytes_out += getattr(batch_out, "nbytes", 0)
+        self.bytes_out += batch_nbytes(batch_out)
         if self.log_every and self.batches % self.log_every == 0:
             logger.info(
                 "stream: %d batches, %d rows, %.0f rows/s",

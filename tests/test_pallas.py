@@ -577,3 +577,30 @@ def test_auto_block_n_shape_aware():
     # partial cache either way (d=16384: 32 blocks never fit) -> largest
     # tile wins (measured faster: fewer grid rows regenerating)
     assert _auto_block_n(16384, 16384, 512, "split2") == 1024
+
+
+@requires_tpu
+def test_no_cache_fallback_is_value_identical():
+    """The VMEM-safety degeneration (ADVICE r4: retry with the mask cache
+    disabled when an untested shape blows scoped VMEM) must not change
+    values: the (seed, block) mask streams are cache-independent."""
+    import jax.numpy as jnp
+
+    from randomprojection_tpu.ops import pallas_kernels as pk
+
+    x = np.random.default_rng(5).normal(size=(700, 900)).astype(np.float32)
+    k = 64
+    key = ((700, 900), None, k, "split2")
+    ref = np.asarray(
+        pk.fused_sparse_project(jnp.asarray(x), 3, k, 0.25, mxu_mode="split2")
+    )
+    pk._NO_CACHE_KEYS.add(key)
+    try:
+        got = np.asarray(
+            pk.fused_sparse_project(
+                jnp.asarray(x), 3, k, 0.25, mxu_mode="split2"
+            )
+        )
+    finally:
+        pk._NO_CACHE_KEYS.discard(key)
+    np.testing.assert_array_equal(ref, got)

@@ -288,3 +288,36 @@ def test_countsketch_csr_async_returns_device_handle():
     y = cs._transform_async(Xs)
     assert isinstance(y, jax.Array)
     np.testing.assert_allclose(np.asarray(y), cs.transform(Xs), rtol=1e-6)
+
+
+def test_countsketch_csr_device_guard_uses_padded_rows():
+    """ADVICE r4: the int32 flat-index guard must count the PADDED rows —
+    ``_transform_csr_jax`` buckets rows up to +25% (``row_bucket``) and the
+    flat scatter index spans ``n_pad*k``, so a batch in the narrow band
+    where ``n*k < 2^31 <= row_bucket(n)*k`` would silently overflow int32
+    on device if the guard used the raw row count."""
+    from types import SimpleNamespace
+
+    cs = CountSketch(256, random_state=0, backend="jax").fit_schema(
+        8, 16, np.float32
+    )
+    ok = SimpleNamespace(dtype=np.dtype(np.float32), shape=(1024, 16))
+    assert cs._csr_on_device(ok)
+    # raw product (2^23-1)*256 = 2^31-256 passes a raw-row guard, but
+    # row_bucket pads to 2^23 rows and 2^23*256 == 2^31 overflows
+    edge = SimpleNamespace(dtype=np.dtype(np.float32), shape=(2**23 - 1, 16))
+    assert not cs._csr_on_device(edge)
+
+    # under a mesh the accumulator is per shard (scatter_kernel(rps)): the
+    # same batch spans only 2^23/8 * 256 = 2^28 indices per shard — it must
+    # NOT be routed to the single-core host fallback at pod scale
+    import jax
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+    cs8 = CountSketch(
+        256, random_state=0, backend="jax", mesh=mesh
+    ).fit_schema(8, 16, np.float32)
+    assert cs8._csr_on_device(edge)

@@ -398,3 +398,43 @@ def test_token_source_validation_and_values():
     (lo, batch), = src.iter_batches()
     assert batch.shape == (4, 1 << 10) and batch.dtype == np.float32
     assert set(np.abs(batch.data)) == {2.5}  # weights survived hashing
+
+
+def test_stream_stats_counts_sparse_input_bytes():
+    """ADVICE r4: scipy CSR has no ``.nbytes``, so the old
+    ``getattr(batch, 'nbytes', 0)`` recorded ``bytes_in=0`` for every
+    sparse stream; the payload is data+indices+indptr."""
+    from randomprojection_tpu.models.sketch import CountSketch
+    from randomprojection_tpu.streaming import RowBatchSource
+    from randomprojection_tpu.utils.observability import (
+        StreamStats,
+        batch_nbytes as _batch_nbytes,
+    )
+
+    rng = np.random.default_rng(0)
+    X = sp.random(64, 128, density=0.1, random_state=0,
+                  dtype=np.float32, format="csr")
+    assert _batch_nbytes(X) == (
+        X.data.nbytes + X.indices.nbytes + X.indptr.nbytes
+    )
+    coo = X.tocoo()
+    assert _batch_nbytes(coo) >= coo.data.nbytes + 2 * coo.row.nbytes
+    dense = rng.normal(size=(4, 4)).astype(np.float32)
+    assert _batch_nbytes(dense) == dense.nbytes
+
+    class CsrSource(RowBatchSource):
+        def schema(self):
+            return X.shape[0], X.shape[1], X.dtype
+
+        def iter_batches(self, start_row=0):
+            for lo in range(start_row, X.shape[0], 32):
+                yield lo, X[lo : lo + 32]
+
+    cs = CountSketch(16, random_state=0, backend="jax").fit_schema(
+        *X.shape, np.float32
+    )
+    stats = StreamStats()
+    for _ in stream_transform(cs, CsrSource(), stats=stats):
+        pass
+    assert stats.bytes_in > 0
+    assert stats.rows == X.shape[0]
