@@ -521,8 +521,24 @@ class JaxBackend(ProjectionBackend):
             else:
                 mxu_mode, xc = self._lazy_mxu_mode(), x.astype(jnp.float32)
             if self.mesh is not None:
+                # per-SHAPE memo of scoped-VMEM compile failures: jit
+                # compiles the (shape-agnostic) mesh fn per input shape, so
+                # one exotic batch shape blowing VMEM must route only ITS
+                # shape to the degraded no-cache variant — healthy shapes
+                # keep the cached-mask kernel (same shape granularity as
+                # pallas_kernels._NO_CACHE_KEYS)
+                oom_shapes = self.__dict__.setdefault(
+                    "_lazy_oom_shapes", set()
+                )
+                shape_key = (
+                    state.seed, state.density, spec.n_components, mxu_mode,
+                    tuple(xc.shape),
+                )
                 try:
-                    y = self._get_lazy_mesh_fn(state, spec, mxu_mode)(xc)
+                    y = self._get_lazy_mesh_fn(
+                        state, spec, mxu_mode,
+                        no_cache=shape_key in oom_shapes,
+                    )(xc)
                 except Exception as e:  # pragma: no cover — Mosaic VMEM OOM
                     # the shard_map compiles outside fused_sparse_project's
                     # own eager fallback frame, so the scoped-VMEM retry
@@ -534,17 +550,10 @@ class JaxBackend(ProjectionBackend):
 
                     if not is_vmem_oom(e):
                         raise
-                    fallback = self._get_lazy_mesh_fn(
+                    oom_shapes.add(shape_key)
+                    y = self._get_lazy_mesh_fn(
                         state, spec, mxu_mode, no_cache=True
-                    )
-                    # rebind the failing key so later batches of this model
-                    # go straight to the degeneration instead of repaying
-                    # the failed Mosaic compile every time
-                    self._lazy_mesh_fns[
-                        (state.seed, state.density, spec.n_components,
-                         mxu_mode, False)
-                    ] = fallback
-                    y = fallback(xc)
+                    )(xc)
                 y = y.astype(x.dtype)
             else:
                 from randomprojection_tpu.ops.pallas_kernels import (

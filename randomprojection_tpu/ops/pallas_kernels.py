@@ -321,9 +321,10 @@ def is_vmem_oom(exc: Exception) -> bool:
     """Classify a Mosaic scoped-VMEM exhaustion (the one failure the
     no-cache degeneration can fix) — shared by the eager fallback above and
     the mesh call site (``jax_backend._project_prepared``), so the two
-    paths cannot drift when an error wording changes."""
-    msg = str(exc).lower()
-    return "vmem" in msg or "scoped" in msg
+    paths cannot drift when an error wording changes.  Matches the memory
+    specifically ('vmem', which covers 'scoped vmem' spellings) — a bare
+    'scoped' would misroute unrelated errors into the degraded retry."""
+    return "vmem" in str(exc).lower()
 
 
 @functools.partial(

@@ -577,3 +577,26 @@ def test_cli_argument_validation():
     ):
         with pytest.raises(SystemExit):
             cli.build_parser().parse_args(argv)
+
+
+def test_baseline_numbers_in_sync():
+    """BASELINE.md's recorded-numbers block is generated from the latest
+    committed BENCH_r*.json (VERDICT r4 weak #1: hand-written prose
+    contradicted the driver capture).  Fail if the block and the JSON
+    drift — regenerate with `python docs/gen_bench_tables.py`."""
+    import pathlib
+    import sys as _sys
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    _sys.path.insert(0, str(repo / "docs"))
+    try:
+        import gen_bench_tables as g
+    finally:
+        _sys.path.pop(0)
+    current = (repo / "BASELINE.md").read_text()
+    lo = current.index(g.BEGIN)
+    hi = current.index(g.END) + len(g.END)
+    assert current[lo:hi] == g.render(g.latest_bench_path()), (
+        "BASELINE.md bench block is stale — run "
+        "`python docs/gen_bench_tables.py`"
+    )
