@@ -191,18 +191,22 @@ def _host_best_of(sample, trials: int = 3, max_trials: int = 7):
 
 
 def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
-                    k: int = 256, steps: int = 16) -> dict:
+                    k: int = 256) -> dict:
     """Config-5 throughputs (SURVEY.md §1: streaming TF-IDF hashing), all
     at the stated ``hash_space = 2^20`` — the sketch runs ON DEVICE via the
     CSR gather/scatter path (``models/sketch.py::_transform_csr_jax``; no
     one-hot can exist at d=2^20).
 
     - ``ingest_tokens_per_s``: host feature-hashing of a flat token column
-      (C++ murmur3, one FFI call per batch), best-of-3.
+      (C++ murmur3, one FFI call per batch), best-of-N with escalation.
     - ``device_sketch_docs_per_s``: the device hot loop alone, tokens
-      resident, through the anti-cache scan harness (this box's call cache
-      serves naive repeat timings — BASELINE.md).  Cross-checked against
-      the scatter's own HBM roofline (``sketch_hbm_cap_docs_per_s``).
+      resident, timed as honest PER-BATCH dispatches (the real streaming
+      pattern; the scan harness serializes TPU gather/scatter lowering
+      ~500× and was r4's 303k-docs/s artifact).  The shipped doc-major
+      compare-reduce kernel is reported; ``sketch_bakeoff_docs_per_s``
+      records it against the flat gather+scatter and the packed-table
+      gather floor.  Cross-checked against the byte roofline
+      (``sketch_hbm_cap_docs_per_s``).
     - ``end_to_end_docs_per_s``: THE pipeline number — raw tokens →
       murmur3 CSR → device sketch through ``TokenSource`` +
       ``transform_stream`` (overlapped batches), wall-clock including all
@@ -241,52 +245,97 @@ def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
     try:
         ingest_stats = _host_best_of(ingest_sample)
 
-        # --- device hot loop, tokens resident, anti-cache scan harness ---
+        # --- device hot loop, tokens resident, per-batch dispatches ---
+        # r5 instrument finding (the r3-fold story repeating): inside a
+        # lax.scan EVERY gather/scatter kernel variant collapses to ~0.3M
+        # docs/s on this box (the loop forces a serialized lowering) while
+        # honest standalone dispatches differ 4x between kernels — and
+        # real streaming IS one dispatch per batch.  So this times
+        # per-batch calls: distinct values every call (call index folded
+        # on device), calls serialized on a carry scalar, every output
+        # forced via the carry.
+        from randomprojection_tpu.models.sketch import (
+            _docmajor_chunk,
+            _docmajor_kernel,
+        )
+        from randomprojection_tpu.parallel.sharded import row_bucket
+
         cs = CountSketch(k, random_state=0, backend="jax").fit_schema(
             n_docs, d, np.float32
         )
+        hs = cs._device_packed_table()
         h_dev, s_dev = cs._device_tables()
         rows = jnp.asarray(
             np.repeat(np.arange(n_docs, dtype=np.int32), tok_per_doc)
         )
         idx0, _ = hash_tokens(toks, d)
-        idx = jnp.asarray(idx0)
-        vals0 = jnp.asarray(
-            rng.standard_normal(n_tokens, dtype=np.float32).reshape(
-                n_docs, tok_per_doc
+        # pad to the SAME bucketed doc-major layout the shipped kernel uses
+        # (_transform_csr_docmajor pads rows to t_pad; pad tokens value 0)
+        t_pad = row_bucket(tok_per_doc)
+        idxm = jnp.asarray(
+            np.pad(
+                idx0.reshape(n_docs, tok_per_doc).astype(np.int32),
+                ((0, 0), (0, t_pad - tok_per_doc)),
             )
         )
+        idx_flat = jnp.asarray(idx0)
+        vals0 = jnp.asarray(
+            np.pad(
+                rng.standard_normal(n_tokens, dtype=np.float32).reshape(
+                    n_docs, tok_per_doc
+                ),
+                ((0, 0), (0, t_pad - tok_per_doc)),
+            )
+        )
+        dm_kernel = _docmajor_kernel(
+            k, t_pad, _docmajor_chunk(n_docs, t_pad, k)
+        )
 
-        def project(v):
-            # v (n_docs, tok_per_doc): the doc-major value layout lets the
-            # harness fold its carry per doc row; the scatter sees the
-            # flat token stream.  z is a data-dependent zero (v is the
-            # scan carry): with constant idx/rows XLA would hoist the
-            # per-token gathers and index arithmetic out of the scan,
-            # timing a gather-free loop that real streaming (fresh tokens
-            # every batch) never sees.
-            z = (v[0, 0] * 1e-30).astype(jnp.int32)
-            flat = (rows + z) * k + h_dev[idx + z]
+        # the shipped doc-major kernel itself (shared builder — the bench
+        # cannot drift from _transform_csr_docmajor), the pre-r5 flat
+        # kernel, and the table-lookup floor every d=2^20 kernel must pay
+        def dm_body(v, z, idxm, hs):
+            return dm_kernel(idxm + z, v, hs)
+
+        def flat_body(v, z, idx_flat, rows, h_dev, s_dev):
+            flat = (rows + z) * k + h_dev[idx_flat + z]
             y = jnp.zeros((n_docs * k,), jnp.float32)
             return y.at[flat].add(
-                v.reshape(-1) * s_dev[idx + z].astype(jnp.float32)
+                v[:, :tok_per_doc].reshape(-1)
+                * s_dev[idx_flat + z].astype(jnp.float32)
             ).reshape(n_docs, k)
 
-        calls = 3
-        docs_per_s, elapsed, _ = _scan_harness(
-            jax, jnp, project, vals0, steps, calls
+        def gather_floor_body(v, z, idxm, hs):
+            return v * (hs[idxm + z] & 1).astype(jnp.float32)
+
+        def _per_batch_rate(body, operands, calls=5):
+            # honest per-batch dispatches: token/table operands are passed
+            # as jit ARGUMENTS (closure constants could be constant-folded
+            # — the gather would then be compiled away) and additionally
+            # offset by a data-dependent zero; values are distinct per
+            # call and calls chain on a carry scalar
+            @jax.jit
+            def one(v, carry, ci, *ops):
+                z = (carry * 1e-30).astype(jnp.int32)
+                v = v + (carry * 1e-24 + ci * 1e-6).astype(v.dtype)
+                return body(v, z, *ops).sum() * jnp.float32(1e-30)
+
+            c = one(vals0, jnp.float32(0), jnp.float32(-1), *operands)
+            c.block_until_ready()
+            t0 = time.perf_counter()
+            for i in range(calls):
+                c = one(vals0, c, jnp.float32(i), *operands)
+            c.block_until_ready()
+            return calls * n_docs / (time.perf_counter() - t0)
+
+        docs_per_s = _per_batch_rate(dm_body, (idxm, hs))
+        flat_docs_per_s = _per_batch_rate(
+            flat_body, (idx_flat, rows, h_dev, s_dev)
         )
-        # scatter HBM floor per step: per token read rows+idx (8B), gather
-        # h (4B) + s (1B) at random offsets, read vals (4B); RMW y once
-        # (8B/element); plus the harness fold's own read+write of
-        # fold_cols value columns per doc (the 64-col floor dominates
-        # tok_per_doc/32 at default widths)
-        fold_cols = min(harness_fold_cols(tok_per_doc), tok_per_doc)
-        step_bytes = (
-            n_tokens * (4 + 4 + 4 + 1 + 4)
-            + n_docs * k * 8
-            + n_docs * 2 * fold_cols * 4
-        )
+        gather_floor = _per_batch_rate(gather_floor_body, (idxm, hs))
+        # per-batch byte floor: read idx (4B/token) + packed-table gather
+        # (4B/token random) + vals (4B/token) + write y (4B/element)
+        step_bytes = n_tokens * (4 + 4 + 4) + n_docs * k * 4
         cap_docs = 819e9 / (step_bytes / n_docs)
 
         # --- the ONE pipeline number: tokens -> CSR -> device sketch ----
@@ -320,11 +369,17 @@ def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
         "device_sketch_docs_per_s": round(docs_per_s, 1),
         "sketch_hbm_cap_docs_per_s": round(cap_docs, 1),
         "sketch_timing_suspect": bool(docs_per_s > 2 * cap_docs),
+        "sketch_bakeoff_docs_per_s": {
+            "docmajor_compare_reduce": round(docs_per_s, 1),
+            "flat_gather_scatter": round(flat_docs_per_s, 1),
+            "packed_gather_floor": round(gather_floor, 1),
+        },
+        "sketch_instrument": "per_batch_chained",
         "end_to_end_docs_per_s": round(e2e, 1),
         "tokens_per_doc": tok_per_doc,
         "hash_space": d,
         "sketch_k": k,
-        "countsketch_kernel": "csr_gather_scatter",
+        "countsketch_kernel": "csr_docmajor_compare_reduce",
     }
 
 
@@ -738,7 +793,7 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
         "config5": (
             measure_config5()
             if preset == "full"
-            else measure_config5(n_docs=8192, steps=4)
+            else measure_config5(n_docs=8192)
         ),
     }
 

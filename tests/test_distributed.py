@@ -256,6 +256,21 @@ shards2 = {s.index[0].start or 0: np.asarray(s.data) for s in y2.addressable_sha
 out["tp_full"] = np.concatenate([shards2[s] for s in sorted(shards2)])
 assert out["tp_full"].shape == (n, k)  # every host holds all rows (feature-replicated)
 
+# --- ESTIMATOR over the global DPxTP mesh (VERDICT r4 #9): fit runs
+# materialize_sharded across processes — the counter-based PRNG must
+# derive each process's column shard of the SAME global matrix ---
+from randomprojection_tpu import SparseRandomProjection
+
+est_tp = SparseRandomProjection(
+    k, random_state=11, density=0.25, backend="jax",
+    backend_options={"mesh": mesh2, "feature_axis": "feature"},
+)
+est_tp.fit_schema(n, d, dtype=np.float32)
+yg = est_tp.transform(Xg2)  # device-resident in -> device handle out
+eshards = {s.index[0].start or 0: np.asarray(s.data) for s in yg.addressable_shards}
+out["est_tp_full"] = np.concatenate([eshards[s] for s in sorted(eshards)])
+assert out["est_tp_full"].shape == (n, k)
+
 # --- deployment pattern: host_row_range over the stream, a LOCAL mesh of
 # this host's 4 devices under the estimator ---
 from randomprojection_tpu import GaussianRandomProjection
@@ -332,6 +347,30 @@ def test_pod_topology_two_process_mesh(tmp_path):
     )
     np.testing.assert_allclose(w0["tp_full"], ref_tp, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(w1["tp_full"], ref_tp, rtol=1e-5, atol=1e-6)
+
+    # estimator across processes (VERDICT r4 #9): the pod workers' fit ran
+    # materialize_sharded over the multi-host mesh — the sharding-invariant
+    # PRNG must yield the same matrix as this process's single-host fit of
+    # the identical estimator on the identically-decomposed mesh
+    from randomprojection_tpu import SparseRandomProjection
+
+    est_ref = SparseRandomProjection(
+        16, random_state=11, density=0.25, backend="jax",
+        backend_options={"mesh": mesh_tp, "feature_axis": "feature"},
+    )
+    est_ref.fit_schema(n, d, dtype=np.float32)
+    ref_est = np.asarray(est_ref.transform(X))
+    np.testing.assert_allclose(w0["est_tp_full"], ref_est, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w1["est_tp_full"], ref_est, rtol=1e-5, atol=1e-6)
+    # and the mesh must not have changed the MATRIX itself: the same seed
+    # with no mesh at all agrees (same counter-based streams)
+    est_plain = SparseRandomProjection(
+        16, random_state=11, density=0.25, backend="jax"
+    )
+    est_plain.fit_schema(n, d, dtype=np.float32)
+    np.testing.assert_allclose(
+        ref_est, np.asarray(est_plain.transform(X)), rtol=1e-5, atol=1e-5
+    )
 
     # streamed host_row_range + local mesh: concat equals the one-process
     # estimator (same seed => same matrix regardless of mesh/topology)
