@@ -1,0 +1,93 @@
+"""Trace-backed attribution of the lazy_split2 residual (VERDICT r4 #2).
+
+Runs the bench's own scan harness for ``lazy_split2`` at the headline
+shape under ``jax.profiler.trace``, then parses the captured xplane and
+prints the device-time decomposition: how much of each while-loop step is
+the fused Pallas kernel vs the harness fold, and how much wall time falls
+between calls (dispatch).  The findings are recorded in BASELINE.md
+("Attribution of the residual", r5 trace paragraph).
+
+Needs the real chip.  Beware the call cache: a process that measured
+nothing else first has been observed serving the harness at impossible
+rates (37 GROWS/s once) — this script warms with a dense mode first, the
+way the full bench does, and prints the untraced rate so a cache-served
+run is self-evident.
+
+Usage: python experiments/trace_attribution.py [trace_dir]
+"""
+
+import glob
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_traced(trace_dir: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from randomprojection_tpu import benchmark as B
+
+    d, k, density = 4096, 256, 1 / 3
+    cfg = dict(batch=131072, steps=64, calls=2)
+    Rf = jax.random.normal(jax.random.key(0), (k, d), jnp.float32)
+    r0 = B.measure_mode(jax, jnp, Rf, "bf16", 1.0, d=d, **cfg)
+    print(f"bf16 warm: {r0['rows_per_s'] / 1e6:.1f}M rows/s")
+    kw = dict(k=k, density=density, lazy_seed=0)
+    r1 = B.measure_mode(jax, jnp, None, "lazy_split2", 1.0, d=d, **cfg, **kw)
+    print(f"lazy_split2 untraced: {r1['rows_per_s'] / 1e6:.1f}M rows/s")
+    with jax.profiler.trace(trace_dir):
+        r2 = B.measure_mode(
+            jax, jnp, None, "lazy_split2", 1.0, d=d, **cfg, **kw
+        )
+    print(f"lazy_split2 traced: {r2['rows_per_s'] / 1e6:.1f}M rows/s")
+
+
+def analyze(trace_dir: str) -> None:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    path = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/*.xplane.pb"))[-1]
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    plane = next(p for p in xs.planes if p.name == "/device:TPU:0")
+    emeta = {m.id: m.name for m in plane.event_metadata.values()}
+    ops = next(ln for ln in plane.lines if ln.name == "XLA Ops")
+    agg = defaultdict(lambda: [0, 0.0])
+    for e in ops.events:
+        name = emeta.get(e.metadata_id, "?")
+        agg[name][0] += 1
+        agg[name][1] += e.duration_ps / 1e12
+    whiles = {n: v for n, v in agg.items() if n.startswith("%while")}
+    kernel = {n: v for n, v in agg.items() if "_fused_impl" in n}
+    w_total = sum(v[1] for v in whiles.values())
+    # the kernel can appear under several event names (custom-call plus
+    # async wrappers); the STEP count is the count of any single name
+    steps = max((v[0] for v in kernel.values()), default=0)
+    k_total = sum(v[1] for v in kernel.values())
+    print(f"\nwhile loops: {w_total:.3f}s total")
+    print(
+        f"fused kernel custom-call: {steps} steps, {k_total:.3f}s "
+        f"({k_total / max(w_total, 1e-9):.0%} of loop time, "
+        f"{k_total / max(steps, 1) * 1e3:.2f} ms/step)"
+    )
+    others = sorted(
+        (
+            (n, v)
+            for n, v in agg.items()
+            if n not in whiles and n not in kernel and v[1] > 1e-3
+        ),
+        key=lambda kv: -kv[1][1],
+    )
+    for n, (c, t) in others[:6]:
+        print(f"  {t:7.3f}s x{c:5d}  {n[:80]}")
+
+
+if __name__ == "__main__":
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/rp_trace"
+    run_traced(trace_dir)
+    time.sleep(1)
+    analyze(trace_dir)

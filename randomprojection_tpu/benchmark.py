@@ -368,7 +368,14 @@ def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
         "ingest_hash_threads": 1,
         "device_sketch_docs_per_s": round(docs_per_s, 1),
         "sketch_hbm_cap_docs_per_s": round(cap_docs, 1),
-        "sketch_timing_suspect": bool(docs_per_s > 2 * cap_docs),
+        # suspect when past the byte roofline OR materially past the
+        # packed-table gather floor measured in the SAME run — no real
+        # d=2^20 kernel can beat the table lookup it contains, so a
+        # cache-served sample (observed on this box at ~100x) trips this
+        # even though it sits far below the byte roofline
+        "sketch_timing_suspect": bool(
+            docs_per_s > 2 * cap_docs or docs_per_s > 1.5 * gather_floor
+        ),
         "sketch_bakeoff_docs_per_s": {
             "docmajor_compare_reduce": round(docs_per_s, 1),
             "flat_gather_scatter": round(flat_docs_per_s, 1),

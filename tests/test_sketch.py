@@ -368,9 +368,9 @@ def test_countsketch_csr_docmajor_mesh_matches(monkeypatch):
     X[np.abs(X) < 1.0] = 0.0
     Xs = sp.csr_array(X)
     mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
-    Ym = CountSketch(
-        32, random_state=0, backend="jax", mesh=mesh
-    ).fit(Xs).transform(Xs)
+    csm = CountSketch(32, random_state=0, backend="jax", mesh=mesh).fit(Xs)
+    Ym = csm.transform(Xs)
+    assert "docmajor" in [k[0] for k in csm._csr_fns], list(csm._csr_fns)
     Y1 = CountSketch(32, random_state=0, backend="jax").fit(Xs).transform(Xs)
     np.testing.assert_allclose(Ym, Y1, rtol=1e-6, atol=1e-6)
     Yn = CountSketch(32, random_state=0, backend="numpy").fit(Xs).transform(Xs)
