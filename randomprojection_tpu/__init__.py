@@ -18,7 +18,7 @@ from randomprojection_tpu.utils.validation import (
     NotFittedError,
 )
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 _LAZY_ESTIMATORS = (
     "BaseRandomProjection",

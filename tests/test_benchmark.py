@@ -93,3 +93,47 @@ def test_host_best_of_escalates_on_suspect_spread():
     seq = iter([100.0, 40.0, 10.0, 5.0, 3.0, 2.0, 1.0])
     r = _host_best_of(lambda: next(seq))
     assert r["trials"] == 7 and r["host_suspect"]
+
+
+def test_gen_bench_tables_recovers_truncated_tail():
+    """The BASELINE generator must rebuild mode/config records from a
+    FRONT-TRUNCATED driver tail (the driver keeps only the end of the
+    bench line) and re-derive the headline with select_headline."""
+    import pathlib
+    import sys as _sys
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    _sys.path.insert(0, str(repo / "docs"))
+    try:
+        import gen_bench_tables as g
+    finally:
+        _sys.path.pop(0)
+
+    tail = (
+        'on": 0.001, "elapsed_s": 1.0, "timing_suspect": false}, '
+        '"slow_mode": {"rows_per_s": 1000.0, "distortion": 1e-06, '
+        '"executed_tflops": 1.0, "mxu_utilization": 0.1, '
+        '"harness_hbm_cap_rows_per_s": 2000.0, "timing_suspect": false}, '
+        '"fast_mode": {"rows_per_s": 5000.0, "distortion": 1e-06, '
+        '"executed_tflops": 5.0, "mxu_utilization": 0.5, '
+        '"harness_hbm_cap_rows_per_s": 9000.0, "timing_suspect": false}, '
+        '"config1": {"workload": "w", "rows_per_s": 10.0, '
+        '"trial_spread": 1.0, "host_suspect": false}}'
+    )
+    rec = g._recover_from_tail(tail)
+    assert set(rec["all_modes"]) == {"slow_mode", "fast_mode"}
+    assert rec["mode"] == "fast_mode" and rec["value"] == 5000.0
+    assert rec["config1"]["rows_per_s"] == 10.0
+    assert rec["_recovered_from_truncated_tail"]
+    # and the renderer accepts the recovered record
+    import json
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "BENCH_r99.json")
+        with open(p, "w") as f:
+            json.dump({"n": 1, "cmd": "", "rc": 0, "tail": tail,
+                       "parsed": None}, f)
+        block = g.render(p)
+    assert "fast_mode" in block and "5.0k" in block
