@@ -398,10 +398,12 @@ def _brute_topk(A, B, m):
 
 
 @pytest.mark.parametrize("use_mesh", [False, True])
-def test_simhash_index_query_topk_matches_bruteforce(devices, use_mesh):
+def test_simhash_index_query_topk_matches_bruteforce(request, use_mesh):
     """query_topk must equal brute force under the documented tie policy
     (lower global id wins) on ragged shapes, across mesh/no-mesh, small-m
-    and m > n_codes, and across chunk boundaries (post-add)."""
+    and m > n_codes, and across chunk boundaries (post-add).  The no-mesh
+    variant needs no fixture, so it ALSO runs on the real chip under
+    RP_TEST_TPU=1 — on-chip coverage for the serving primitive."""
     from randomprojection_tpu import SimHashIndex
     from randomprojection_tpu.parallel import make_mesh
 
@@ -411,6 +413,8 @@ def test_simhash_index_query_topk_matches_bruteforce(devices, use_mesh):
     pool = rng.integers(0, 256, size=(13, 6), dtype=np.uint8)
     B = pool[rng.integers(0, 13, size=333)]
     A = pool[rng.integers(0, 13, size=29)]
+    if use_mesh:
+        request.getfixturevalue("devices")
     mesh = make_mesh({"data": 8}) if use_mesh else None
     idx = SimHashIndex(B, mesh=mesh)
 
@@ -439,9 +443,10 @@ def test_simhash_index_query_topk_matches_bruteforce(devices, use_mesh):
         idx.query_topk(A, 0)
 
 
-def test_simhash_index_topk_crosses_scan_blocks(devices):
+def test_simhash_index_topk_crosses_scan_blocks():
     """A chunk larger than _TOPK_ROW_BLOCK exercises the scanned running
-    top-k (carry merge), not just one block."""
+    top-k (carry merge), not just one block.  No mesh — also runs on the
+    real chip under RP_TEST_TPU=1."""
     from randomprojection_tpu import SimHashIndex
     from randomprojection_tpu.models import sketch as sketch_mod
 
