@@ -385,16 +385,11 @@ def test_simhash_index_resident_shards(devices, monkeypatch):
 
 
 def _brute_topk(A, B, m):
-    """Reference top-m under the documented total order (distance, id)."""
-    from randomprojection_tpu.models.sketch import pairwise_hamming
+    """Reference top-m under the documented total order (distance, id) —
+    the library's own host reference, so the encoding cannot drift."""
+    from randomprojection_tpu.models.sketch import topk_bruteforce
 
-    D = pairwise_hamming(A, B).astype(np.int64)
-    key = (D << 34) | np.arange(B.shape[0], dtype=np.int64)[None, :]
-    sel = np.argsort(key, axis=1, kind="stable")[:, :m]
-    return (
-        np.take_along_axis(D, sel, axis=1).astype(np.int32),
-        sel.astype(np.int32),
-    )
+    return topk_bruteforce(A, B, m)
 
 
 @pytest.mark.parametrize("use_mesh", [False, True])
@@ -488,3 +483,16 @@ def test_countsketch_mesh_input_arrives_row_sharded(devices):
     assert seen[0] == NamedSharding(mesh, P("data", None)), seen[0]
     Y1 = CountSketch(16, random_state=0, backend="jax").fit(X).transform(X)
     np.testing.assert_allclose(Y, Y1, rtol=1e-5, atol=1e-6)
+
+
+def test_topk_block_clamp_keeps_key_in_int32():
+    """Wide codes must shrink the scan block (not error): the packed
+    selection key dist*(m+blk)+pos has to fit int32 for any code width."""
+    from randomprojection_tpu.models.sketch import _topk_block_clamp
+
+    # 256-bit codes: the default block passes untouched
+    assert _topk_block_clamp(32768, 16, 257) == 32768
+    # 131072-bit codes (16 KiB/code): halves until the key fits
+    blk = _topk_block_clamp(32768, 16, 131073)
+    assert blk == 8192
+    assert (131073 + 1) * (16 + blk) < 2**31
