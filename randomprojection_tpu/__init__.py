@@ -31,6 +31,7 @@ _LAZY_ESTIMATORS = (
     "pairwise_hamming_device",
     "pairwise_hamming_sharded",
     "cosine_from_hamming",
+    "topk_bruteforce",
 )
 
 __all__ = [

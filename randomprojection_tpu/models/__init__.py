@@ -18,6 +18,7 @@ from randomprojection_tpu.models.sketch import (
     pairwise_hamming,
     pairwise_hamming_device,
     pairwise_hamming_sharded,
+    topk_bruteforce,
 )
 
 __all__ = [
@@ -31,4 +32,5 @@ __all__ = [
     "pairwise_hamming_device",
     "pairwise_hamming_sharded",
     "cosine_from_hamming",
+    "topk_bruteforce",
 ]
