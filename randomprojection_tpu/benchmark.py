@@ -22,7 +22,9 @@ PRESETS = {
     # because a dispatch costs ~100-133 ms on the virtualized dev chip
     # (BASELINE.md round-3 finding): work per dispatch must dwarf the
     # dispatch overhead or the bench measures the tunnel, not the chip.
-    "full": dict(batch=131072, steps=128, calls=3),  # 16.8M rows per call
+    # r5 trace: at 128 steps ~13% of wall was still call-boundary gaps;
+    # 256 steps measured +2.5% with the 3-call anti-cache chain intact.
+    "full": dict(batch=131072, steps=256, calls=3),  # 33.6M rows per call
     "smoke": dict(batch=8192, steps=2, calls=2),
 }
 

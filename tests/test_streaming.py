@@ -445,7 +445,6 @@ def test_countsketch_stream_through_docmajor_kernel(monkeypatch):
     compare-reduce kernel when eligible (r5) and still commit correct,
     in-order batches."""
     from randomprojection_tpu.models.sketch import CountSketch
-    from randomprojection_tpu.streaming import RowBatchSource
 
     monkeypatch.setattr(CountSketch, "_DOCMAJOR_MAX_INFLATION", 1e9)
     rng = np.random.default_rng(30)
@@ -453,19 +452,11 @@ def test_countsketch_stream_through_docmajor_kernel(monkeypatch):
     X[np.abs(X) < 1.0] = 0.0
     Xs = sp.csr_array(X)
 
-    class S(RowBatchSource):
-        def schema(self):
-            return Xs.shape[0], Xs.shape[1], Xs.dtype
-
-        def iter_batches(self, start_row=0):
-            for lo in range(start_row, Xs.shape[0], 64):
-                yield lo, Xs[lo : lo + 64]
-
     cs = CountSketch(32, random_state=0, backend="jax").fit_schema(
         *Xs.shape, np.float32
     )
     got = []
-    for lo, y in stream_transform(cs, S()):
+    for lo, y in stream_transform(cs, ArraySource(Xs, 64)):
         got.append((lo, np.asarray(y)))
     assert [lo for lo, _ in got] == [0, 64, 128, 192]
     assert any(k[0] == "docmajor" for k in cs._csr_fns), list(cs._csr_fns)
