@@ -327,6 +327,44 @@ class JaxBackend(ProjectionBackend):
         y, _ = self._transform_impl(X, state, spec)
         return y
 
+    def prepare_batch(self, X, spec: ProjectionSpec):
+        """Prefetch-stage hook: start the H2D upload of a streaming batch
+        OFF the dispatch thread (``streaming.PrefetchSource(prepare=...)``).
+
+        Returns a device-resident array that ``_prepare_rows`` recognizes
+        (``device_resident=True``), so the later ``_transform_async`` call
+        only pads on device and launches the kernel — the transfer overlaps
+        the previous batch's device compute instead of serializing in the
+        dispatch path.  Under a mesh the batch is returned unchanged (the
+        dispatch path pads *before* sharding, so an early unsharded upload
+        would just be re-laid-out); host backends never see this method.
+        """
+        import jax
+
+        from randomprojection_tpu.utils.observability import annotate
+
+        if self.mesh is not None:
+            return X
+        x = self._host_cast(X, allow_bf16=spec.dtype == "bfloat16")
+        with annotate("rp:stream/h2d_prefetch"):
+            return jax.device_put(x)
+
+    def _host_cast(self, X, *, allow_bf16: bool):
+        """Densify + apply the dtype policy (bf16 pass-through only when
+        the spec allows it) + make contiguous — the host half of
+        ``_prepare_rows``' preamble, shared with ``prepare_batch`` so the
+        bytes-on-wire policy cannot drift between the prefetched and
+        synchronous paths."""
+        import jax.numpy as jnp
+
+        if sp.issparse(X):
+            X = X.toarray()
+        X = np.asarray(X)
+        keep_bf16 = allow_bf16 and jnp.dtype(X.dtype) == jnp.bfloat16
+        return np.ascontiguousarray(
+            X, dtype=None if keep_bf16 else self.compute_dtype
+        )
+
     def _prepare_rows(self, X, *, allow_bf16: bool = False):
         """Shared batch preamble: densify, cast, row-bucket pad, shard, place.
 
@@ -339,30 +377,20 @@ class JaxBackend(ProjectionBackend):
 
         with annotate("rp:backend/prepare"):
             device_resident = isinstance(X, jax.Array)
-            if sp.issparse(X):
-                X = X.toarray()
 
             # bf16 inputs stay bf16 through the h2d transfer (half the PCIe
             # bytes — SURVEY.md §7 R3); einsum/type promotion upcasts on
             # DEVICE, which is exact (every bf16 value is exact in f32).
             # Gated on the spec's dtype policy (``allow_bf16``): an
             # estimator fitted f32 must keep producing f32 even when handed
-            # a bf16 array.
-            keep_bf16 = (
-                allow_bf16
-                and getattr(X, "dtype", None) is not None
-                and jnp.dtype(X.dtype) == jnp.bfloat16
-            )
-
+            # a bf16 array.  The host half of the policy lives in
+            # ``_host_cast`` (shared with ``prepare_batch``).
             if device_resident:
+                keep_bf16 = allow_bf16 and jnp.dtype(X.dtype) == jnp.bfloat16
                 x = X if keep_bf16 else X.astype(jnp.dtype(self.compute_dtype))
-                n = x.shape[0]
             else:
-                X = np.asarray(X)
-                n = X.shape[0]
-                x = np.ascontiguousarray(
-                    X, dtype=None if keep_bf16 else self.compute_dtype
-                )
+                x = self._host_cast(X, allow_bf16=allow_bf16)
+            n = x.shape[0]
 
             from randomprojection_tpu.parallel.sharded import row_bucket
 
@@ -550,10 +578,22 @@ class JaxBackend(ProjectionBackend):
 
                     if not is_vmem_oom(e):
                         raise
-                    oom_shapes.add(shape_key)
+                    from randomprojection_tpu.utils.observability import (
+                        logger,
+                    )
+
+                    logger.warning(
+                        "fused lazy kernel hit a scoped-VMEM limit for "
+                        "shape %s; retrying without the in-VMEM mask cache "
+                        "(regenerate-every-step degradation)", shape_key,
+                    )
                     y = self._get_lazy_mesh_fn(
                         state, spec, mxu_mode, no_cache=True
                     )(xc)
+                    # memoize only now that the degraded retry actually
+                    # compiled: a misclassified failure must not pin this
+                    # shape to the slow path for the process lifetime
+                    oom_shapes.add(shape_key)
                 y = y.astype(x.dtype)
             else:
                 from randomprojection_tpu.ops.pallas_kernels import (

@@ -211,9 +211,14 @@ def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
       (``sketch_hbm_cap_docs_per_s``).
     - ``end_to_end_docs_per_s``: THE pipeline number — raw tokens →
       murmur3 CSR → device sketch through ``TokenSource`` +
-      ``transform_stream`` (overlapped batches), wall-clock including all
-      hashing and transfers.  On this 1-core box it is ingest-bound by
-      construction; the components above attribute the gap.
+      ``PrefetchSource`` + ``transform_stream``, wall-clock including all
+      hashing and transfers.  The r6 overlapped pipeline: hashing (C++
+      kernel, multi-threaded — bit-identical to serial) and early H2D run
+      on the prefetch worker while the consumer dispatches/fetches;
+      ``pipeline_overlap_ratio`` and ``pipeline_stage_wall_s`` attribute
+      the wall (hash / h2d / dispatch / d2h) and quantify the overlap.
+      ``end_to_end_serial_docs_per_s`` keeps the pre-r6 synchronous loop
+      (serial-pinned hashing) for round-over-round comparability.
     """
     import os
 
@@ -351,11 +356,46 @@ def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
         est = CountSketch(k, random_state=0, backend="jax").fit_source(source)
         for _, _y in est.transform_stream(source):  # warm compile, 1 batch
             break
+        # serial reference: the pre-r6 synchronous consume loop (hash, H2D,
+        # dispatch, d2h all on one thread, hashing pinned serial by the env
+        # above) — kept for round-over-round comparability
         t0 = time.perf_counter()
         n_done = 0
         for _lo, y in est.transform_stream(source):
             n_done += y.shape[0]
+        e2e_serial = n_done / (time.perf_counter() - t0)
+
+        # pipelined path (r6): PrefetchSource runs hashing + early H2D on
+        # a worker thread (hash multi-threaded via the C++ kernel —
+        # bit-identical output), the consumer only dispatches and fetches.
+        # Same TokenSource, same batch size, same per-batch-dispatch
+        # methodology — only the serialization changes.
+        from randomprojection_tpu.streaming import PrefetchSource
+        from randomprojection_tpu.utils.observability import StreamStats
+
+        hash_threads = max(os.cpu_count() or 1, 1)
+        prefetch_depth = 3
+        stats = StreamStats()
+        psource = PrefetchSource(
+            TokenSource(
+                read_tokens, n_docs, fh, batch_rows=8192,
+                hash_threads=hash_threads, stats=stats,
+            ),
+            depth=prefetch_depth, prepare=est.prepare_batch, stats=stats,
+        )
+        t0 = time.perf_counter()
+        n_done = 0
+        for _lo, y in est.transform_stream(psource, stats=stats):
+            n_done += y.shape[0]
         e2e = n_done / (time.perf_counter() - t0)
+        # the overlapped pipeline cannot outrun its slowest stage: flag a
+        # cache-served sample that beats the device sketch measured in the
+        # SAME run, or the threaded-hash ceiling
+        pipe_ceiling = min(
+            docs_per_s,
+            ingest_stats["best"] * hash_threads / tok_per_doc,
+        )
+        pipe_suspect = bool(e2e > 1.2 * pipe_ceiling)
     finally:
         if prev is None:
             os.environ.pop("RP_HASH_THREADS", None)
@@ -385,6 +425,16 @@ def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
         },
         "sketch_instrument": "per_batch_chained",
         "end_to_end_docs_per_s": round(e2e, 1),
+        "end_to_end_serial_docs_per_s": round(e2e_serial, 1),
+        "pipeline_overlap_ratio": round(stats.overlap_ratio(), 3),
+        "pipeline_stage_wall_s": {
+            name: round(wall, 4)
+            for name, wall in sorted(stats.stage_wall.items())
+        },
+        "pipeline_queue_depth_max": stats.queue_depth_max,
+        "pipeline_hash_threads": hash_threads,
+        "pipeline_prefetch_batches": prefetch_depth,
+        "pipeline_timing_suspect": pipe_suspect,
         "tokens_per_doc": tok_per_doc,
         "hash_space": d,
         "sketch_k": k,

@@ -24,6 +24,15 @@ import numpy as np
 def _add_common(p):
     p.add_argument("--backend", default="auto",
                    choices=["auto", "numpy", "jax"])
+    p.add_argument("--prefetch-batches", type=int, default=0,
+                   help="prefetch depth: run source production (hashing, "
+                        "reads) and early H2D upload on a background "
+                        "worker thread, keeping up to this many batches "
+                        "queued ahead of the consumer (0 = synchronous)")
+    p.add_argument("--hash-threads", type=int, default=None,
+                   help="worker threads for the C++ murmur3 batch hasher "
+                        "(sets RP_HASH_THREADS; output is bit-identical "
+                        "at any count; default: hardware concurrency)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--precision", default=None,
                    choices=["default", "high", "highest", "split2"],
@@ -183,6 +192,20 @@ def _make_estimator(args):
     return rp.CountSketch(k, random_state=args.seed, backend=args.backend)
 
 
+def _wrap_prefetch(source, est, args, stats):
+    """Wrap ``source`` in a ``PrefetchSource`` when ``--prefetch-batches``
+    asks for one: production (and the estimator's early-H2D
+    ``prepare_batch``) moves to a background worker thread."""
+    depth = getattr(args, "prefetch_batches", 0)
+    if not depth:
+        return source
+    from randomprojection_tpu.streaming import PrefetchSource
+
+    return PrefetchSource(
+        source, depth=depth, prepare=est.prepare_batch, stats=stats
+    )
+
+
 def cmd_project(args):
     import os
 
@@ -216,7 +239,7 @@ def cmd_project(args):
         est = _make_estimator(args).fit_source(source)
         with profile_trace(args.profile_dir):
             Y = stream_to_array(
-                est, source, stats=stats,
+                est, _wrap_prefetch(source, est, args, stats), stats=stats,
                 pipeline_depth=args.pipeline_depth,
             )
         if sp.issparse(Y):
@@ -285,7 +308,7 @@ def cmd_project(args):
     try:
         with profile_trace(args.profile_dir):
             out = stream_to_memmap(
-                est, source, out_path,
+                est, _wrap_prefetch(source, est, args, stats), out_path,
                 checkpoint_path=args.checkpoint, stats=stats,
                 pipeline_depth=args.pipeline_depth,
             )
@@ -354,12 +377,13 @@ def cmd_stream_bench(args):
     # to it could prime this box's device call cache for the timed stream)
     est.transform(np.negative(template[: min(args.batch_rows, args.rows) or 1]))
     stats = StreamStats()
+    timed_source = _wrap_prefetch(source, est, args, stats)
     t0 = time.perf_counter()
     with profile_trace(args.profile_dir):
-        for _ in est.transform_stream(source, stats=stats):
+        for _ in est.transform_stream(timed_source, stats=stats):
             pass
     elapsed = time.perf_counter() - t0
-    print(json.dumps({
+    out = {
         "metric": f"host-streamed rows/s {args.d}->{args.k} ({args.kind})",
         "value": round(args.rows / elapsed, 1),
         "unit": "rows/s",
@@ -371,13 +395,36 @@ def cmd_stream_bench(args):
         "backend_options": _backend_options(args),
         "bytes_in": stats.bytes_in,
         "elapsed_s": round(elapsed, 4),
-    }))
+        "prefetch_batches": args.prefetch_batches,
+    }
+    if stats.stage_wall:
+        out["stage_wall_s"] = {
+            k_: round(v, 4) for k_, v in sorted(stats.stage_wall.items())
+        }
+        out["pipeline_overlap_ratio"] = round(stats.overlap_ratio(), 3)
+        out["queue_depth_max"] = stats.queue_depth_max
+    print(json.dumps(out))
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if hasattr(args, "log_level"):
         logging.basicConfig(level=getattr(logging, args.log_level.upper()))
+    if getattr(args, "prefetch_batches", 0) < 0:
+        raise SystemExit(
+            f"--prefetch-batches must be >= 0, got {args.prefetch_batches}"
+        )
+    if getattr(args, "hash_threads", None) is not None:
+        if args.hash_threads < 1:
+            raise SystemExit(
+                f"--hash-threads must be >= 1, got {args.hash_threads}"
+            )
+        # process default for every batch-hash call (the C++ kernel reads
+        # RP_HASH_THREADS per call); TokenSource(hash_threads=...) can
+        # still override per stream
+        import os
+
+        os.environ["RP_HASH_THREADS"] = str(args.hash_threads)
     # debug switches (SURVEY.md §6): applied before any jax computation
     if getattr(args, "debug_nans", False):
         import jax

@@ -312,6 +312,21 @@ class BaseRandomProjection(ParamsMixin):
             X, self._state, self.spec_, dense_output=self._dense_output()
         )
 
+    def prepare_batch(self, X):
+        """Prefetch-stage hook (``streaming.PrefetchSource(prepare=...)``):
+        validate a batch and start its H2D upload from the prefetch worker
+        thread, returning an object ``_transform_async`` accepts with no
+        further host work — so the transfer overlaps device compute instead
+        of serializing in the dispatch path.  Backends without an upload
+        step (numpy) return the batch unchanged, making the hook safe to
+        wire unconditionally."""
+        self._check_is_fitted()
+        X = self._validate_for_transform(X, self.n_features_in_, "features")
+        prepare = getattr(self._backend, "prepare_batch", None)
+        if prepare is None:
+            return X
+        return prepare(X, self.spec_)
+
     def _stream_out_dtype(self):
         """Dtype committed stream batches are cast to (None = leave as-is)."""
         return self.spec_.np_dtype

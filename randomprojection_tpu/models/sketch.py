@@ -34,6 +34,7 @@ from randomprojection_tpu.utils.validation import NotFittedError, check_array
 __all__ = [
     "SignRandomProjection",
     "CountSketch",
+    "DeviceBatch",
     "SimHashIndex",
     "pairwise_hamming",
     "pairwise_hamming_device",
@@ -167,20 +168,27 @@ def cosine_from_hamming(hamming, n_bits: int):
     return np.cos(np.pi * np.asarray(hamming, dtype=np.float64) / n_bits)
 
 
-def topk_bruteforce(A, B, m: int):
-    """Host reference for ``SimHashIndex.query_topk``: exact top-``m``
-    under the documented (distance, lower-global-id) total order.
-
-    O(n_queries · n_codes) host work — verification and small data only.
-    The single source of the tie-policy encoding, shared by the test
-    suite and the driver dryrun so the reference cannot drift."""
-    D = pairwise_hamming(A, B).astype(np.int64)
-    key = (D << 34) | np.arange(B.shape[0], dtype=np.int64)[None, :]
+def _host_topk_select(D, m: int):
+    """Exact host top-``m`` of a dense distance matrix under the
+    (distance, lower-global-id) total order — the single source of the
+    tie-policy encoding, shared by ``topk_bruteforce``, the test suite,
+    and ``query_topk``'s dense fallback, so the policy cannot drift."""
+    D = np.asarray(D).astype(np.int64)
+    shift = max(int(D.shape[1]).bit_length(), 1)
+    key = (D << shift) | np.arange(D.shape[1], dtype=np.int64)[None, :]
     sel = np.argsort(key, axis=1, kind="stable")[:, :m]
     return (
         np.take_along_axis(D, sel, axis=1).astype(np.int32),
         sel.astype(np.int32),
     )
+
+
+def topk_bruteforce(A, B, m: int):
+    """Host reference for ``SimHashIndex.query_topk``: exact top-``m``
+    under the documented (distance, lower-global-id) total order.
+
+    O(n_queries · n_codes) host work — verification and small data only."""
+    return _host_topk_select(pairwise_hamming(A, B), m)
 
 
 def _topk_block_clamp(blk: int, m_c: int, sentinel: int) -> int:
@@ -191,6 +199,19 @@ def _topk_block_clamp(blk: int, m_c: int, sentinel: int) -> int:
     while blk > 8 and (sentinel + 1) * (m_c + blk) >= 2**31:
         blk //= 2
     return blk
+
+
+def _topk_key_fits_int32(n_bits_total: int, m_c: int, row_block: int) -> bool:
+    """Whether the on-device top-k's packed int32 selection key can
+    represent a request after ``_topk_block_clamp`` bottoms out —
+    requires ``(n_bits+2)·(m_c+blk) < 2**31`` at the clamped block.  When
+    it cannot (very wide codes, or ``m ≳ 2^31/(n_bits+2)`` — ~8.3M at
+    256-bit codes), ``query_topk`` falls back to the dense ``query()`` +
+    host-selection path instead of raising (ADVICE r5)."""
+    sentinel = n_bits_total + 1
+    blk = _topk_block_clamp(row_block, m_c, sentinel)
+    width = m_c + blk
+    return sentinel * width + width < 2**31
 
 
 class _IndexChunk:
@@ -236,6 +257,11 @@ class SimHashIndex:
     1B codes is 8 TB d2h).  The serving path is ``query_topk``: the
     top-``m`` candidates are selected ON DEVICE and only ``O(m)`` values
     per query cross the host boundary.
+
+    Capacity: at most ``2**31 - 1`` codes per index — device ids are
+    int32 end to end, so ``add`` refuses past that rather than silently
+    wrapping global ids (scale out further by sharding more chips over a
+    mesh, which divides rows without widening the id space).
     """
 
     def __init__(self, codes, *, mesh=None, data_axis: str = "data",
@@ -265,6 +291,16 @@ class SimHashIndex:
         import jax.numpy as jnp
 
         n = codes.shape[0]
+        if self.n_codes + n >= 2**31:
+            # every device-side id (row0, local_ids, best_i) and the
+            # returned idx are int32: past 2^31-1 codes, global ids would
+            # silently wrap and query_topk would return wrong neighbors.
+            # The beyond-one-HBM growth story is sharding more chips over
+            # the SAME id space, not widening it — refuse loudly here.
+            raise ValueError(
+                f"SimHashIndex is limited to 2**31 - 1 codes (int32 device "
+                f"ids); have {self.n_codes}, adding {n} would overflow"
+            )
         if self.mesh is None:
             b = jnp.asarray(codes)
         else:
@@ -381,6 +417,15 @@ class SimHashIndex:
         distance matrix never exists anywhere — HBM holds one block's
         scores, and d2h per query is ``O(p·m)`` (shard candidates), not
         ``O(n_codes)``.  Host work is merging ``p·m`` candidates per query.
+
+        Device-path bound: the scanned selection packs ``(dist, position)``
+        into one int32 key, which requires ``(n_bits+2)·(m+blk) < 2**31``
+        at the clamped scan block (``blk ≥ 8``) — so ``m`` up to
+        ``~2^31/(n_bits+2)`` (≈8.3M at 256-bit codes) runs on device.
+        Larger requests (or very wide codes) fall back to the dense
+        ``query()`` + host selection path: same results, same (distance,
+        lower-id) tie order, but d2h is the full ``O(n_codes)`` row —
+        analysis-scale throughput, not serving-scale.
         """
         if not isinstance(m, numbers.Integral) or m <= 0:
             raise ValueError(f"m must be a positive int, got {m!r}")
@@ -390,6 +435,23 @@ class SimHashIndex:
         import jax.numpy as jnp
 
         m_eff = int(min(m, self.n_codes))
+        if not all(
+            _topk_key_fits_int32(
+                self.n_bytes * 8,
+                int(min(m_eff, c.n)),
+                min(self._TOPK_ROW_BLOCK, c.b.shape[0]),
+            )
+            for c in self._chunks
+        ):
+            # int32 key packing cannot represent the request on device:
+            # serve it through the dense path rather than raising
+            out_d = np.empty((A.shape[0], m_eff), dtype=np.int32)
+            out_i = np.empty((A.shape[0], m_eff), dtype=np.int32)
+            for lo in range(0, A.shape[0], tile):
+                hi = min(lo + tile, A.shape[0])
+                d, i = _host_topk_select(self.query(A[lo:hi], tile=tile), m_eff)
+                out_d[lo:hi], out_i[lo:hi] = d, i
+            return out_d, out_i
         nq = A.shape[0]
         out_d = np.empty((nq, m_eff), dtype=np.int32)
         out_i = np.empty((nq, m_eff), dtype=np.int32)
@@ -569,6 +631,31 @@ class SimHashIndex:
             )
         self._topk_fns[key] = fn
         return fn
+
+
+class DeviceBatch:
+    """A streaming batch already laid out and uploaded for one device
+    kernel, produced by ``CountSketch.prepare_batch`` on the prefetch
+    worker thread so the H2D transfer overlaps device compute.
+
+    ``kind`` names the kernel the layout targets (``'docmajor'`` /
+    ``'flat'``); ``arrays`` are the device operands in that kernel's
+    argument order.  ``shape``/``nbytes`` mirror the source CSR batch so
+    the streaming layer's bookkeeping (row counts, ``batch_nbytes``) is
+    unchanged by preparation.
+    """
+
+    __slots__ = ("kind", "arrays", "n", "n_pad", "t_pad", "shape", "nbytes")
+
+    def __init__(self, kind: str, arrays: tuple, n: int, n_pad: int,
+                 t_pad: int, shape: tuple, nbytes: int):
+        self.kind = kind
+        self.arrays = arrays
+        self.n = n
+        self.n_pad = n_pad
+        self.t_pad = t_pad
+        self.shape = shape
+        self.nbytes = nbytes
 
 
 def _docmajor_kernel(k: int, t_pad: int, chunk: int):
@@ -871,7 +958,7 @@ class CountSketch(ParamsMixin):
         rows up to +25% (``row_bucket``), and the flat index spans
         ``n_pad·k``, so guarding on the raw ``n`` would admit a narrow band
         of batches that overflow after padding.  Under a mesh the scatter
-        accumulator is PER SHARD (``scatter_kernel(rps)``), so the guard
+        accumulator is PER SHARD (``_scatter_body(rps)``), so the guard
         scales by the data-axis size — a batch the mesh path handles must
         not be routed to the host fallback."""
         from randomprojection_tpu.parallel.sharded import row_bucket
@@ -919,6 +1006,71 @@ class CountSketch(ParamsMixin):
     _DOCMAJOR_MAX_INFLATION = 4.0
     _DOCMAJOR_MAX_WIDTH = 2048
 
+    def _docmajor_host_layout(self, X, n_pad: int, t_pad: int):
+        """CSR → padded doc-major ``(idxm, valm)`` numpy pair (host work
+        only — shared by the dispatch path and ``prepare_batch``, so the
+        prefetch worker lays out and uploads without duplicating the
+        kernel's layout rules).  Pad tokens carry value 0."""
+        n = X.shape[0]
+        counts = np.diff(X.indptr)
+        row_ids = np.repeat(np.arange(n, dtype=np.int64), counts)
+        pos = np.arange(X.nnz, dtype=np.int64) - np.repeat(
+            X.indptr[:-1].astype(np.int64), counts
+        )
+        idxm = np.zeros((n_pad, t_pad), np.int32)
+        valm = np.zeros((n_pad, t_pad), np.float32)
+        idxm[row_ids, pos] = X.indices
+        valm[row_ids, pos] = X.data
+        return idxm, valm
+
+    def _docmajor_fn(self, n_pad: int, t_pad: int):
+        """The cached jitted doc-major kernel for one padded shape."""
+        import jax
+
+        k = self.n_components_
+        p = 1 if self.mesh is None else self.mesh.shape[self.data_axis]
+        fns = self.__dict__.setdefault("_csr_fns", {})
+        key = ("docmajor", n_pad, t_pad, p)
+        fn = fns.get(key)
+        if fn is None:
+            chunk = _docmajor_chunk(n_pad // p, t_pad, k)
+            kernel = _docmajor_kernel(k, t_pad, chunk)
+            if self.mesh is None:
+                fn = jax.jit(kernel)
+            else:
+                from jax.sharding import PartitionSpec as P
+
+                fn = jax.jit(
+                    jax.shard_map(
+                        kernel, mesh=self.mesh,
+                        in_specs=(
+                            P(self.data_axis, None),
+                            P(self.data_axis, None),
+                            P(),
+                        ),
+                        out_specs=P(self.data_axis, None),
+                    )
+                )
+            fns[key] = fn
+        return fn
+
+    def _docmajor_dispatch(self, idxm_dev, valm_dev, n: int, n_pad: int,
+                           t_pad: int, *, materialize: bool):
+        """Dispatch the doc-major kernel on already-device-resident
+        operands and slice pad rows."""
+        from randomprojection_tpu.parallel.sharded import slice_rows_sharded
+
+        y = self._docmajor_fn(n_pad, t_pad)(
+            idxm_dev, valm_dev, self._device_packed_table()
+        )
+        y = slice_rows_sharded(
+            y, n, self.mesh, self.data_axis,
+            cache=self.__dict__.setdefault("_slice_fns", {}),
+        )
+        if materialize:
+            return np.asarray(y)
+        return y
+
     def _transform_csr_docmajor(self, X, n_pad: int, t_pad: int, *,
                                 materialize: bool = True):
         """Doc-major compare-reduce sketch — the d=2^20 winner (r5 bake-off).
@@ -939,64 +1091,19 @@ class CountSketch(ParamsMixin):
         import jax
         import jax.numpy as jnp
 
-        from randomprojection_tpu.parallel.sharded import slice_rows_sharded
-
-        n = X.shape[0]
-        k = self.n_components_
-        counts = np.diff(X.indptr)
-        row_ids = np.repeat(np.arange(n, dtype=np.int64), counts)
-        pos = np.arange(X.nnz, dtype=np.int64) - np.repeat(
-            X.indptr[:-1].astype(np.int64), counts
-        )
-        idxm = np.zeros((n_pad, t_pad), np.int32)
-        valm = np.zeros((n_pad, t_pad), np.float32)
-        idxm[row_ids, pos] = X.indices
-        valm[row_ids, pos] = X.data
-        hs = self._device_packed_table()
-
-        p = 1 if self.mesh is None else self.mesh.shape[self.data_axis]
-        rows_local = n_pad // p
-        chunk = _docmajor_chunk(rows_local, t_pad, k)
-
-        fns = self.__dict__.setdefault("_csr_fns", {})
-        key = ("docmajor", n_pad, t_pad, p)
-        fn = fns.get(key)
-        if fn is None:
-            kernel = _docmajor_kernel(k, t_pad, chunk)
-            if self.mesh is None:
-                fn = jax.jit(kernel)
-            else:
-                from jax.sharding import PartitionSpec as P
-
-                fn = jax.jit(
-                    jax.shard_map(
-                        kernel, mesh=self.mesh,
-                        in_specs=(
-                            P(self.data_axis, None),
-                            P(self.data_axis, None),
-                            P(),
-                        ),
-                        out_specs=P(self.data_axis, None),
-                    )
-                )
-            fns[key] = fn
-
+        idxm, valm = self._docmajor_host_layout(X, n_pad, t_pad)
         if self.mesh is None:
-            y = fn(jnp.asarray(idxm), jnp.asarray(valm), hs)
+            idxm_dev, valm_dev = jnp.asarray(idxm), jnp.asarray(valm)
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             sh = NamedSharding(self.mesh, P(self.data_axis, None))
-            y = fn(
-                jax.device_put(idxm, sh), jax.device_put(valm, sh), hs
-            )
-        y = slice_rows_sharded(
-            y, n, self.mesh, self.data_axis,
-            cache=self.__dict__.setdefault("_slice_fns", {}),
+            idxm_dev = jax.device_put(idxm, sh)
+            valm_dev = jax.device_put(valm, sh)
+        return self._docmajor_dispatch(
+            idxm_dev, valm_dev, X.shape[0], n_pad, t_pad,
+            materialize=materialize,
         )
-        if materialize:
-            return np.asarray(y)
-        return y
 
     def _transform_csr_jax(self, X, *, materialize: bool = True):
         """Sketch a CSR batch ON DEVICE (config 5's hot loop — BL:11).
@@ -1033,7 +1140,81 @@ class CountSketch(ParamsMixin):
         )
 
         n = X.shape[0]
-        k = self.n_components_
+        kind, n_pad, t_row = self._csr_route(X)
+        if kind == "docmajor":
+            return self._transform_csr_docmajor(
+                X, n_pad, t_row, materialize=materialize
+            )
+        if self.mesh is None:
+            rows, idx, vals, t_pad = self._flat_host_layout(X)
+            return self._flat_dispatch(
+                jnp.asarray(rows), jnp.asarray(idx), jnp.asarray(vals),
+                n, n_pad, t_pad, materialize=materialize,
+            )
+
+        indptr = X.indptr.astype(np.int64, copy=False)
+        fns = self.__dict__.setdefault("_csr_fns", {})
+        h_dev, s_dev = self._device_tables()
+
+        from jax.sharding import PartitionSpec as P
+
+        p = self.mesh.shape[self.data_axis]
+        rps = n_pad // p  # rows per shard (row_bucket pads to 8p)
+        # shard s owns rows [s·rps, (s+1)·rps): its token range is
+        # indptr[lo]:indptr[hi] — the CSR layout is already partitioned
+        bounds = indptr[np.minimum(np.arange(p + 1) * rps, n)]
+        counts = np.diff(bounds)
+        t_pad = row_bucket(int(max(counts.max(), 1)))
+        rows_l = np.zeros((p, t_pad), dtype=np.int32)
+        idx_s = np.zeros((p, t_pad), dtype=np.int32)
+        vals_s = np.zeros((p, t_pad), dtype=np.float32)
+        row_sizes = np.diff(indptr)
+        for s in range(p):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            c = hi - lo
+            r0, r1 = s * rps, min((s + 1) * rps, n)
+            rows_l[s, :c] = np.repeat(
+                np.arange(r1 - r0, dtype=np.int32), row_sizes[r0:r1]
+            )
+            idx_s[s, :c] = X.indices[lo:hi]
+            vals_s[s, :c] = X.data[lo:hi]
+        fn = fns.get((n_pad, t_pad, p))
+        if fn is None:
+            kernel = self._scatter_body(rps)
+
+            def shard_body(rows, idx, vals, h, s):
+                # operands arrive (1, t_pad) per shard: squeeze, then
+                # run the shared kernel on this shard's row block
+                return kernel(rows[0], idx[0], vals[0], h, s)
+
+            da = self.data_axis
+            fn = jax.jit(
+                jax.shard_map(
+                    shard_body, mesh=self.mesh,
+                    in_specs=(P(da, None),) * 3 + (P(), P()),
+                    out_specs=P(da, None),
+                )
+            )
+            fns[(n_pad, t_pad, p)] = fn
+        y = fn(rows_l, idx_s, vals_s, h_dev, s_dev)
+        y = slice_rows_sharded(
+            y, n, self.mesh, self.data_axis,
+            cache=self.__dict__.setdefault("_slice_fns", {}),
+        )
+        if materialize:
+            return np.asarray(y)
+        return y
+
+    def _csr_route(self, X):
+        """Kernel selection for one CSR batch — the SINGLE source of the
+        doc-major/flat eligibility rule, shared by ``_transform_csr_jax``
+        and ``prepare_batch`` so prepared and unprepared batches always
+        target the same jitted program.  Returns ``(kind, n_pad, t_pad)``
+        with ``kind`` ``'docmajor'`` (t_pad = bucketed max row width) or
+        ``'flat'`` (t_pad None — the flat layout buckets by nnz)."""
+        from randomprojection_tpu.parallel.sharded import row_bucket
+
+        n = X.shape[0]
         n_pad = row_bucket(max(n, 1), self.mesh, self.data_axis)
         t_max = int(np.diff(X.indptr).max()) if n else 0
         if t_max:
@@ -1043,83 +1224,65 @@ class CountSketch(ParamsMixin):
                 and n_pad * t_row
                 <= self._DOCMAJOR_MAX_INFLATION * max(X.nnz, 1)
             ):
-                return self._transform_csr_docmajor(
-                    X, n_pad, t_row, materialize=materialize
-                )
-        indptr = X.indptr.astype(np.int64, copy=False)
+                return "docmajor", n_pad, t_row
+        return "flat", n_pad, None
+
+    def _scatter_body(self, n_rows: int):
+        """The one flat device sketch body (single-chip and per-shard):
+        gather the resident tables at the batch's token indices,
+        scatter-add into the flat ``(n_rows·k)`` accumulator."""
+        import jax.numpy as jnp
+
+        k = self.n_components_
+
+        def body(rows, idx, vals, h, s):
+            flat = rows * k + h[idx]
+            y = jnp.zeros((n_rows * k,), jnp.float32)
+            return y.at[flat].add(
+                vals * s[idx].astype(jnp.float32)
+            ).reshape(n_rows, k)
+
+        return body
+
+    def _flat_host_layout(self, X):
+        """CSR → padded flat ``(rows, idx, vals, t_pad)`` numpy arrays for
+        the gather+scatter kernel (host work only — shared by the dispatch
+        path and ``prepare_batch``)."""
+        from randomprojection_tpu.parallel.sharded import row_bucket
+
+        n = X.shape[0]
+        rows = np.repeat(
+            np.arange(n, dtype=np.int32),
+            np.diff(X.indptr.astype(np.int64, copy=False)),
+        )
+        t_pad = row_bucket(max(X.nnz, 1))
+        pad = t_pad - X.nnz
+        rows = np.pad(rows, (0, pad))
+        idx = np.pad(X.indices.astype(np.int32, copy=False), (0, pad))
+        vals = np.pad(X.data, (0, pad))
+        return rows, idx, vals, t_pad
+
+    def _flat_fn(self, n_pad: int, t_pad: int):
+        """The cached jitted single-chip flat kernel for one padded shape."""
+        import jax
+
         fns = self.__dict__.setdefault("_csr_fns", {})
+        fn = fns.get((n_pad, t_pad))
+        if fn is None:
+            fn = jax.jit(self._scatter_body(n_pad))
+            fns[(n_pad, t_pad)] = fn
+        return fn
+
+    def _flat_dispatch(self, rows_dev, idx_dev, vals_dev, n: int,
+                       n_pad: int, t_pad: int, *, materialize: bool):
+        """Dispatch the flat kernel on already-device-resident operands
+        (single-chip path) and slice pad rows."""
+        from randomprojection_tpu.parallel.sharded import slice_rows_sharded
+
         h_dev, s_dev = self._device_tables()
-
-        def scatter_kernel(n_rows):
-            # the one device sketch body (shared by both branches): gather
-            # the resident tables at the batch's token indices, scatter-add
-            # into the flat (n_rows·k) accumulator
-            def body(rows, idx, vals, h, s):
-                flat = rows * k + h[idx]
-                y = jnp.zeros((n_rows * k,), jnp.float32)
-                return y.at[flat].add(
-                    vals * s[idx].astype(jnp.float32)
-                ).reshape(n_rows, k)
-
-            return body
-
-        if self.mesh is None:
-            rows = np.repeat(
-                np.arange(n, dtype=np.int32), np.diff(indptr)
-            )
-            t_pad = row_bucket(max(X.nnz, 1))
-            pad = t_pad - X.nnz
-            rows = np.pad(rows, (0, pad))
-            idx = np.pad(X.indices.astype(np.int32, copy=False), (0, pad))
-            vals = np.pad(X.data, (0, pad))
-            fn = fns.get((n_pad, t_pad))
-            if fn is None:
-                fn = jax.jit(scatter_kernel(n_pad))
-                fns[(n_pad, t_pad)] = fn
-            y = fn(jnp.asarray(rows), jnp.asarray(idx), jnp.asarray(vals),
-                   h_dev, s_dev)
-        else:
-            from jax.sharding import PartitionSpec as P
-
-            p = self.mesh.shape[self.data_axis]
-            rps = n_pad // p  # rows per shard (row_bucket pads to 8p)
-            # shard s owns rows [s·rps, (s+1)·rps): its token range is
-            # indptr[lo]:indptr[hi] — the CSR layout is already partitioned
-            bounds = indptr[np.minimum(np.arange(p + 1) * rps, n)]
-            counts = np.diff(bounds)
-            t_pad = row_bucket(int(max(counts.max(), 1)))
-            rows_l = np.zeros((p, t_pad), dtype=np.int32)
-            idx_s = np.zeros((p, t_pad), dtype=np.int32)
-            vals_s = np.zeros((p, t_pad), dtype=np.float32)
-            row_sizes = np.diff(indptr)
-            for s in range(p):
-                lo, hi = int(bounds[s]), int(bounds[s + 1])
-                c = hi - lo
-                r0, r1 = s * rps, min((s + 1) * rps, n)
-                rows_l[s, :c] = np.repeat(
-                    np.arange(r1 - r0, dtype=np.int32), row_sizes[r0:r1]
-                )
-                idx_s[s, :c] = X.indices[lo:hi]
-                vals_s[s, :c] = X.data[lo:hi]
-            fn = fns.get((n_pad, t_pad, p))
-            if fn is None:
-                kernel = scatter_kernel(rps)
-
-                def shard_body(rows, idx, vals, h, s):
-                    # operands arrive (1, t_pad) per shard: squeeze, then
-                    # run the shared kernel on this shard's row block
-                    return kernel(rows[0], idx[0], vals[0], h, s)
-
-                da = self.data_axis
-                fn = jax.jit(
-                    jax.shard_map(
-                        shard_body, mesh=self.mesh,
-                        in_specs=(P(da, None),) * 3 + (P(), P()),
-                        out_specs=P(da, None),
-                    )
-                )
-                fns[(n_pad, t_pad, p)] = fn
-            y = fn(rows_l, idx_s, vals_s, h_dev, s_dev)
+        y = self._flat_fn(n_pad, t_pad)(
+            rows_dev, idx_dev, vals_dev, h_dev, s_dev
+        )
         y = slice_rows_sharded(
             y, n, self.mesh, self.data_axis,
             cache=self.__dict__.setdefault("_slice_fns", {}),
@@ -1156,11 +1319,74 @@ class CountSketch(ParamsMixin):
 
         return stream_transform(self, source, **kwargs)
 
+    def prepare_batch(self, X):
+        """Prefetch-stage hook (``PrefetchSource(prepare=...)``): lay out a
+        CSR batch for its device kernel and START the H2D upload from the
+        worker thread, so by dispatch time the consumer only launches the
+        kernel — the transfer overlaps the previous batch's device compute
+        instead of sitting in the dispatch path.
+
+        Routing matches ``_transform_csr_jax`` exactly (same doc-major /
+        flat eligibility, same padded shapes, so the same jitted programs
+        serve prepared and unprepared batches).  Batches the device CSR
+        path would not serve (dense, f64, ``use_mxu``, a mesh — the mesh
+        path shards at dispatch) are returned unchanged and take their
+        usual synchronous route."""
+        self._check_is_fitted()
+        if (
+            not sp.issparse(X)
+            or self.use_mxu
+            or self.mesh is not None
+        ):
+            return X
+        X = X.tocsr()
+        if X.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"X has {X.shape[1]} features, expected "
+                f"{self.n_features_in_}"
+            )
+        if not self._csr_on_device(X):
+            return X
+        import jax.numpy as jnp
+
+        from randomprojection_tpu.utils.observability import batch_nbytes
+
+        n = X.shape[0]
+        nbytes = batch_nbytes(X)
+        kind, n_pad, t_row = self._csr_route(X)
+        if kind == "docmajor":
+            idxm, valm = self._docmajor_host_layout(X, n_pad, t_row)
+            return DeviceBatch(
+                "docmajor", (jnp.asarray(idxm), jnp.asarray(valm)),
+                n, n_pad, t_row, X.shape, nbytes,
+            )
+        rows, idx, vals, t_pad = self._flat_host_layout(X)
+        return DeviceBatch(
+            "flat",
+            (jnp.asarray(rows), jnp.asarray(idx), jnp.asarray(vals)),
+            n, n_pad, t_pad, X.shape, nbytes,
+        )
+
+    def _dispatch_prepared(self, b: DeviceBatch, *, materialize: bool):
+        """Run the kernel a ``prepare_batch`` upload targeted — no host
+        layout or H2D left on this (the dispatch) thread."""
+        if b.kind == "docmajor":
+            return self._docmajor_dispatch(
+                *b.arrays, b.n, b.n_pad, b.t_pad, materialize=materialize
+            )
+        return self._flat_dispatch(
+            *b.arrays, b.n, b.n_pad, b.t_pad, materialize=materialize
+        )
+
     def _transform_async(self, X):
         """Streaming transform: returns a lazy device handle on the jax
         dense-f32 and CSR-f32 paths so the pipeline overlaps sketch batches
-        (the host paths — f64, numpy backend — stay synchronous)."""
+        (the host paths — f64, numpy backend — stay synchronous).  Accepts
+        ``DeviceBatch`` objects from ``prepare_batch`` (pre-uploaded by the
+        prefetch stage) and dispatches them directly."""
         self._check_is_fitted()
+        if isinstance(X, DeviceBatch):
+            return self._dispatch_prepared(X, materialize=False)
         if sp.issparse(X):
             X = X.tocsr()
             if X.shape[1] != self.n_features_in_:

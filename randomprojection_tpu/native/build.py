@@ -47,16 +47,25 @@ def _compile() -> bool:
 
 
 def load_murmur3() -> Optional[ctypes.CDLL]:
-    """The bound library, or None if no compiler is available."""
+    """The bound library, or None if no compiler is available.
+
+    A stale-but-present .so (source newer than the binary, no compiler to
+    rebuild) still loads: the legacy ABI keeps the fast batch path alive,
+    and bindings added since (the ``*_t`` explicit-thread entry points)
+    degrade gracefully via ``has_explicit_threads`` below — falling all
+    the way to the pure-Python hasher would be orders slower.
+    """
     global _lib, _build_failed
     if _lib is not None:
         return _lib
     if _build_failed:
         return None
-    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+    if not os.path.exists(_SO):
         if not _compile():
             _build_failed = True
             return None
+    elif os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        _compile()  # best effort: on failure the stale .so serves legacy ABI
     lib = ctypes.CDLL(_SO)
     lib.murmur3_32.restype = ctypes.c_uint32
     lib.murmur3_32.argtypes = [
@@ -85,5 +94,20 @@ def load_murmur3() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p,   # int32 out_idx
         ctypes.c_void_p,   # int8 out_sign
     ]
+    # explicit-thread-count entry points (r6): absent from a stale
+    # prebuilt .so — callers then fall back to the RP_HASH_THREADS env
+    # override (ops/hashing.py), same results, process-global knob
+    try:
+        lib.hash_tokens_t.restype = None
+        lib.hash_tokens_t.argtypes = lib.hash_tokens.argtypes + [
+            ctypes.c_int64,  # n_threads (<= 0 = env/hardware default)
+        ]
+        lib.hash_tokens_strided_t.restype = None
+        lib.hash_tokens_strided_t.argtypes = (
+            lib.hash_tokens_strided.argtypes + [ctypes.c_int64]
+        )
+        lib.has_explicit_threads = True
+    except AttributeError:  # pragma: no cover - needs a pre-r6 .so
+        lib.has_explicit_threads = False
     _lib = lib
     return _lib

@@ -34,13 +34,20 @@ static inline uint32_t fmix32(uint32_t h) {
 // Threading: token i's outputs depend only on token i, so splitting the
 // range over threads is bit-identical to the serial loop at any thread
 // count.  Engages only for large batches (>= 2^18 tokens) on multi-core
-// hosts; RP_HASH_THREADS caps or disables (0/1 = serial).  The dev box for
-// this repo has one core — real ingest hosts (config 5: 100M docs) don't.
-static int64_t hash_worker_count(int64_t n_tokens) {
-  int64_t hc = static_cast<int64_t>(std::thread::hardware_concurrency());
-  if (const char* env = std::getenv("RP_HASH_THREADS")) {
-    const long v = std::strtol(env, nullptr, 10);
-    hc = v > 0 ? v : 1;
+// hosts.  The worker count comes from the explicit `n_threads` argument
+// of the *_t entry points (the streaming path's per-call opt-in — no
+// process-global state, safe for concurrent streams); the legacy entry
+// points pass 0 = consult RP_HASH_THREADS / hardware concurrency
+// (0/1 = serial).  The dev box for this repo has one core — real ingest
+// hosts (config 5: 100M docs) don't.
+static int64_t hash_worker_count(int64_t n_tokens, int64_t requested) {
+  int64_t hc = requested;
+  if (hc <= 0) {
+    hc = static_cast<int64_t>(std::thread::hardware_concurrency());
+    if (const char* env = std::getenv("RP_HASH_THREADS")) {
+      const long v = std::strtol(env, nullptr, 10);
+      hc = v > 0 ? v : 1;
+    }
   }
   if (hc <= 1 || n_tokens < (int64_t{1} << 18)) return 1;
   // keep >= 64k tokens per thread so spawn cost stays negligible
@@ -48,8 +55,8 @@ static int64_t hash_worker_count(int64_t n_tokens) {
 }
 
 template <typename Fn>
-static void parallel_over(int64_t n, Fn fn) {
-  const int64_t nw = hash_worker_count(n);
+static void parallel_over(int64_t n, int64_t requested, Fn fn) {
+  const int64_t nw = hash_worker_count(n, requested);
   if (nw == 1) {
     fn(0, n);
     return;
@@ -110,12 +117,14 @@ uint32_t murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
   return fmix32(h1);
 }
 
-// Batch: tokens concatenated in `buf`, token i = buf[offsets[i], offsets[i+1]).
-// Writes idx (|h| mod n_features) and sign (±1) per token.
-void hash_tokens(const uint8_t* buf, const int64_t* offsets, int64_t n_tokens,
-                 uint32_t seed, uint32_t n_features, int32_t* out_idx,
-                 int8_t* out_sign) {
-  parallel_over(n_tokens, [=](int64_t a, int64_t b) {
+// Batch with an explicit worker count (`n_threads`; <= 0 = consult
+// RP_HASH_THREADS / hardware default): tokens concatenated in `buf`,
+// token i = buf[offsets[i], offsets[i+1]).  Writes idx (|h| mod
+// n_features) and sign (±1) per token.
+void hash_tokens_t(const uint8_t* buf, const int64_t* offsets,
+                   int64_t n_tokens, uint32_t seed, uint32_t n_features,
+                   int32_t* out_idx, int8_t* out_sign, int64_t n_threads) {
+  parallel_over(n_tokens, n_threads, [=](int64_t a, int64_t b) {
     for (int64_t i = a; i < b; i++) {
       const int64_t lo = offsets[i];
       const int64_t len = offsets[i + 1] - lo;
@@ -127,15 +136,25 @@ void hash_tokens(const uint8_t* buf, const int64_t* offsets, int64_t n_tokens,
   });
 }
 
+// Legacy ABI (worker count from the environment) — kept so a stale
+// prebuilt .so and the current binding stay interoperable.
+void hash_tokens(const uint8_t* buf, const int64_t* offsets, int64_t n_tokens,
+                 uint32_t seed, uint32_t n_features, int32_t* out_idx,
+                 int8_t* out_sign) {
+  hash_tokens_t(buf, offsets, n_tokens, seed, n_features, out_idx, out_sign,
+                0);
+}
+
 // Strided batch: token i = buf[i*stride, i*stride + lengths[i]).  This is
 // the zero-copy layout of a numpy fixed-width bytes ('S<w>') array, so a
 // whole token column ingests in ONE call with no per-token Python work —
 // the vectorized path for the streaming TF-IDF workload.
-void hash_tokens_strided(const uint8_t* buf, int64_t stride,
-                         const int64_t* lengths, int64_t n_tokens,
-                         uint32_t seed, uint32_t n_features,
-                         int32_t* out_idx, int8_t* out_sign) {
-  parallel_over(n_tokens, [=](int64_t a, int64_t b) {
+void hash_tokens_strided_t(const uint8_t* buf, int64_t stride,
+                           const int64_t* lengths, int64_t n_tokens,
+                           uint32_t seed, uint32_t n_features,
+                           int32_t* out_idx, int8_t* out_sign,
+                           int64_t n_threads) {
+  parallel_over(n_tokens, n_threads, [=](int64_t a, int64_t b) {
     for (int64_t i = a; i < b; i++) {
       const int32_t h = static_cast<int32_t>(
           murmur3_32(buf + i * stride, lengths[i], seed));
@@ -144,6 +163,14 @@ void hash_tokens_strided(const uint8_t* buf, int64_t stride,
       out_sign[i] = h >= 0 ? 1 : -1;
     }
   });
+}
+
+void hash_tokens_strided(const uint8_t* buf, int64_t stride,
+                         const int64_t* lengths, int64_t n_tokens,
+                         uint32_t seed, uint32_t n_features,
+                         int32_t* out_idx, int8_t* out_sign) {
+  hash_tokens_strided_t(buf, stride, lengths, n_tokens, seed, n_features,
+                        out_idx, out_sign, 0);
 }
 
 }  // extern "C"

@@ -14,17 +14,84 @@ cross-check in tests.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import numbers
+import os
 import struct
-from typing import Iterable
+import threading
+from typing import Iterable, Optional
 
 import numpy as np
 import scipy.sparse as sp
 
 from randomprojection_tpu.native.build import load_murmur3
 
-__all__ = ["murmur3_32", "hash_tokens", "FeatureHasher"]
+__all__ = [
+    "murmur3_32", "hash_tokens", "FeatureHasher", "hash_threads_override",
+]
+
+# Worker-count selection for the C++ batch kernel.  The preferred route
+# is the explicit ``n_threads`` argument of the ``*_t`` entry points
+# (native/murmur3.cpp) scoped through a THREAD-LOCAL override — no
+# process-global state, so concurrent streams (e.g. two PrefetchSource
+# pipelines) neither serialize nor leak their setting into each other.
+# A stale prebuilt .so without those symbols falls back to the legacy
+# RP_HASH_THREADS env override, guarded by a lock (process-global, so
+# concurrent overrides serialize there — correctness is unaffected).
+# Output is BIT-IDENTICAL at any thread count — token i's hash depends
+# only on token i — so the override changes wall clock, never values.
+_HASH_THREADS_LOCK = threading.Lock()
+_THREAD_OVERRIDE = threading.local()
+
+
+def _explicit_threads_supported() -> bool:
+    lib = load_murmur3()
+    return lib is not None and getattr(lib, "has_explicit_threads", False)
+
+
+def _requested_threads(n_threads: Optional[int]) -> int:
+    """Resolve the worker count for one kernel call: the explicit argument
+    wins, else this thread's ``hash_threads_override`` scope, else 0 (=
+    the kernel consults RP_HASH_THREADS / hardware concurrency)."""
+    if n_threads is not None:
+        return int(n_threads)
+    return int(getattr(_THREAD_OVERRIDE, "n", None) or 0)
+
+
+@contextlib.contextmanager
+def hash_threads_override(n_threads: Optional[int]):
+    """Scope the C++ batch hasher's worker count around a hash call.
+
+    ``None`` is a no-op (keep the ambient default); any int >= 1 pins the
+    worker count for calls inside the block.  Thread-local when the
+    native library exposes the explicit-thread ABI; legacy .so builds
+    fall back to a locked RP_HASH_THREADS env override.
+    """
+    if n_threads is None:
+        yield
+        return
+    n = int(n_threads)
+    if n < 1:
+        raise ValueError(f"n_threads must be >= 1, got {n_threads!r}")
+    if _explicit_threads_supported():
+        prev = getattr(_THREAD_OVERRIDE, "n", None)
+        _THREAD_OVERRIDE.n = n
+        try:
+            yield
+        finally:
+            _THREAD_OVERRIDE.n = prev
+        return
+    with _HASH_THREADS_LOCK:
+        prev = os.environ.get("RP_HASH_THREADS")
+        os.environ["RP_HASH_THREADS"] = str(n)
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop("RP_HASH_THREADS", None)
+            else:
+                os.environ["RP_HASH_THREADS"] = prev
 
 
 def _murmur3_32_py(data: bytes, seed: int = 0) -> int:
@@ -97,7 +164,8 @@ def _nul_scan(mat2d: np.ndarray):
     return bool(np.any(nz.sum(axis=1) != lengths)), lengths
 
 
-def _hash_token_array(arr: np.ndarray, n_features: int, seed: int):
+def _hash_token_array(arr: np.ndarray, n_features: int, seed: int,
+                      n_threads: Optional[int] = None):
     """Vectorized hashing of a numpy ``U``/``S`` token array.
 
     A fixed-width bytes array IS the strided buffer the C++ kernel wants:
@@ -128,7 +196,8 @@ def _hash_token_array(arr: np.ndarray, n_features: int, seed: int):
         codes = np.ascontiguousarray(arr).view(np.uint32).reshape(n, w)
         embedded, ulens = _nul_scan(codes)
         if embedded:
-            return hash_tokens(arr.tolist(), n_features, seed)
+            return hash_tokens(arr.tolist(), n_features, seed,
+                               n_threads=n_threads)
         if lib is not None and int(codes.max(initial=0)) < 128:
             buf = codes.astype(np.uint8)  # ASCII narrow: one C cast
             lengths = ulens  # ASCII ⇒ byte length == code-unit length
@@ -141,7 +210,8 @@ def _hash_token_array(arr: np.ndarray, n_features: int, seed: int):
         sbuf = arr.view(np.uint8).reshape(n, arr.dtype.itemsize)
         embedded, lengths = _nul_scan(sbuf)
         if embedded:
-            return hash_tokens(arr.tolist(), n_features, seed)
+            return hash_tokens(arr.tolist(), n_features, seed,
+                               n_threads=n_threads)
         if lib is None:  # no compiler: per-token fallback
             for i, tok in enumerate(arr.tolist()):
                 h = murmur3_32(tok, seed)
@@ -150,7 +220,7 @@ def _hash_token_array(arr: np.ndarray, n_features: int, seed: int):
             return idx, sign
         buf = sbuf
 
-    lib.hash_tokens_strided(
+    args = (
         ctypes.c_void_p(buf.ctypes.data),
         buf.shape[1],
         lengths.ctypes.data_as(ctypes.c_void_p),
@@ -160,14 +230,24 @@ def _hash_token_array(arr: np.ndarray, n_features: int, seed: int):
         idx.ctypes.data_as(ctypes.c_void_p),
         sign.ctypes.data_as(ctypes.c_void_p),
     )
+    if getattr(lib, "has_explicit_threads", False):
+        lib.hash_tokens_strided_t(*args, _requested_threads(n_threads))
+    else:
+        lib.hash_tokens_strided(*args)
     return idx, sign
 
 
-def hash_tokens(tokens: Iterable, n_features: int, seed: int = 0):
+def hash_tokens(tokens: Iterable, n_features: int, seed: int = 0,
+                n_threads: Optional[int] = None):
     """Batch-hash tokens → ``(idx int32, sign int8)`` arrays.
 
     Uses the C++ batch kernel on one concatenated buffer (one FFI call for
     the whole batch), falling back to per-token Python hashing.
+
+    ``n_threads`` pins the kernel's worker count for this call (``None`` =
+    this thread's ``hash_threads_override`` scope, else the
+    RP_HASH_THREADS / hardware default).  Output is bit-identical at any
+    count.
 
     Tokens must be ``str`` or ``bytes`` (sklearn ``FeatureHasher`` contract:
     non-string feature names raise ``TypeError`` — an int token passed to
@@ -178,7 +258,8 @@ def hash_tokens(tokens: Iterable, n_features: int, seed: int = 0):
     (``_hash_token_array``): no per-token Python at all.
     """
     if isinstance(tokens, np.ndarray) and tokens.dtype.kind in ("U", "S"):
-        return _hash_token_array(tokens, n_features, seed)
+        return _hash_token_array(tokens, n_features, seed,
+                                 n_threads=n_threads)
     encoded = [
         t.encode("utf-8")
         if isinstance(t, str)
@@ -198,7 +279,7 @@ def hash_tokens(tokens: Iterable, n_features: int, seed: int = 0):
         buf = b"".join(encoded)
         offsets = np.zeros(n + 1, dtype=np.int64)
         np.cumsum([len(e) for e in encoded], out=offsets[1:])
-        lib.hash_tokens(
+        args = (
             buf,
             offsets.ctypes.data_as(ctypes.c_void_p),
             n,
@@ -207,6 +288,10 @@ def hash_tokens(tokens: Iterable, n_features: int, seed: int = 0):
             idx.ctypes.data_as(ctypes.c_void_p),
             sign.ctypes.data_as(ctypes.c_void_p),
         )
+        if getattr(lib, "has_explicit_threads", False):
+            lib.hash_tokens_t(*args, _requested_threads(n_threads))
+        else:
+            lib.hash_tokens(*args)
     else:
         for i, e in enumerate(encoded):
             h = murmur3_32(e, seed)

@@ -306,7 +306,23 @@ def fused_sparse_project(
         except Exception as e:  # pragma: no cover — needs a Mosaic VMEM OOM
             if not is_vmem_oom(e):
                 raise
+            from randomprojection_tpu.utils.observability import logger
+
+            logger.warning(
+                "fused kernel hit a scoped-VMEM limit for key %s; retrying "
+                "without the in-VMEM mask cache (regenerate-every-step "
+                "degradation)", key,
+            )
+            out = _fused_impl(
+                x, seed, n_components, density, block_n=block_n,
+                block_offset=block_offset, mxu_mode=mxu_mode,
+                interpret=interpret, no_cache=True,
+            )
+            # memoize only once the degraded retry actually succeeded: a
+            # misclassified error must not pin this shape to the slow path
+            # for the process lifetime (ADVICE r5)
             _NO_CACHE_KEYS.add(key)
+            return out
     return _fused_impl(
         x, seed, n_components, density, block_n=block_n,
         block_offset=block_offset, mxu_mode=mxu_mode,
@@ -316,15 +332,28 @@ def fused_sparse_project(
 
 _NO_CACHE_KEYS: set = set()
 
+# Phrasings that mark a genuine allocation failure.  Mosaic/XLA spell
+# scoped-VMEM exhaustion variously across versions ("scoped allocation ...
+# exceeds", "RESOURCE_EXHAUSTED", "out of memory", "vmem limit"), so the
+# classifier requires 'vmem' AND one of these — a diagnostic that merely
+# *mentions* VMEM stats no longer routes into the degraded retry.
+_VMEM_OOM_MARKERS = (
+    "exceed", "alloc", "oom", "out of memory", "resource_exhausted",
+    "resource exhausted", "limit", "too large", "too big", "insufficient",
+)
+
 
 def is_vmem_oom(exc: Exception) -> bool:
     """Classify a Mosaic scoped-VMEM exhaustion (the one failure the
     no-cache degeneration can fix) — shared by the eager fallback above and
     the mesh call site (``jax_backend._project_prepared``), so the two
-    paths cannot drift when an error wording changes.  Matches the memory
-    specifically ('vmem', which covers 'scoped vmem' spellings) — a bare
-    'scoped' would misroute unrelated errors into the degraded retry."""
-    return "vmem" in str(exc).lower()
+    paths cannot drift when an error wording changes.  Requires the memory
+    name ('vmem', covering 'scoped vmem' spellings) AND an allocation/
+    exhaustion phrasing (ADVICE r5): a bare 'vmem' match swallowed any
+    error that merely mentioned VMEM and silently degraded that shape to
+    the regenerate-every-step path for the process lifetime."""
+    s = str(exc).lower()
+    return "vmem" in s and any(m in s for m in _VMEM_OOM_MARKERS)
 
 
 @functools.partial(

@@ -17,6 +17,19 @@ SURVEY.md §2 L2, §4.5).  TPU-native design:
   fetched — JAX's async dispatch overlaps them as long as we don't force
   materialization too early.  ``pipeline_depth`` bounds device memory
   (depth × batch bytes).
+- **Async ingest** (the r5 perf finding: ``stream_transform`` consumed
+  ``TokenSource`` synchronously, so murmur3 hashing, H2D transfer and
+  device dispatch all serialized on one thread — the end-to-end config-5
+  number ran ~4.5× slower than host hashing alone).  ``PrefetchSource``
+  wraps any source with a bounded queue fed by a background worker thread:
+  source production (including ``TokenSource``'s per-batch hash) and an
+  optional ``prepare`` step (early ``jax.device_put`` of the batch, so H2D
+  overlaps device compute) run OFF the consumer thread.  The cursor
+  contract is untouched — prefetch changes *when batches are produced*,
+  never when they are committed, so ``rows_done`` still advances only
+  after the consumer has processed the yielded batch (ack-after-yield),
+  and a resume recomputes any batch that was prefetched but never
+  consumed.
 """
 
 from __future__ import annotations
@@ -24,12 +37,18 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import queue
+import threading
 from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
 import scipy.sparse as sp
 
-from randomprojection_tpu.utils.observability import annotate, batch_nbytes
+from randomprojection_tpu.utils.observability import (
+    annotate,
+    batch_nbytes,
+    stage as _stage,
+)
 
 __all__ = [
     "RowBatchSource",
@@ -37,6 +56,7 @@ __all__ = [
     "CallableSource",
     "FaultInjectionSource",
     "TokenSource",
+    "PrefetchSource",
     "StreamCursor",
     "stream_transform",
     "stream_to_array",
@@ -149,27 +169,47 @@ class TokenSource(RowBatchSource):
     checkpoint/resume included (the cursor is rows of documents; resume
     re-hashes from the document boundary, which is exact because
     ``read_tokens`` is deterministic in ``(lo, hi)``).
+
+    ``hash_threads`` opts the per-batch hash into the C++ kernel's
+    thread-parallel path (``native/murmur3.cpp``): the output is
+    bit-identical at any worker count — token i's hash depends only on
+    token i — so this is purely a wall-clock knob.  ``None`` keeps the
+    ambient ``RP_HASH_THREADS``/hardware default.  ``stats`` (a
+    ``StreamStats``) attributes the hash wall to the ``'hash'`` stage;
+    composed with ``PrefetchSource`` the hash then runs on the worker
+    thread, overlapping device compute.
     """
 
     def __init__(self, read_tokens: Callable, n_rows: int, hasher,
-                 batch_rows: int = 65536):
+                 batch_rows: int = 65536, *, hash_threads: Optional[int] = None,
+                 stats=None):
         if batch_rows <= 0:
             raise ValueError(f"batch_rows must be positive, got {batch_rows}")
+        if hash_threads is not None and int(hash_threads) < 1:
+            raise ValueError(
+                f"hash_threads must be >= 1 or None, got {hash_threads!r}"
+            )
         self._read_tokens = read_tokens
         self.hasher = hasher
         self.batch_rows = batch_rows
         self.n_rows = n_rows
         self.n_features = hasher.n_features
         self.dtype = np.dtype(hasher.dtype)
+        self.hash_threads = hash_threads
+        self.stats = stats
 
     def iter_batches(self, start_row: int = 0):
+        from randomprojection_tpu.ops.hashing import hash_threads_override
+
         _check_start_row(start_row, self.batch_rows, self.n_rows)
         for lo in range(start_row, self.n_rows, self.batch_rows):
             hi = min(lo + self.batch_rows, self.n_rows)
             out = self._read_tokens(lo, hi)
             tokens, indptr = out[0], out[1]
             values = out[2] if len(out) > 2 else None
-            with annotate("rp:stream/hash_tokens"):
+            with annotate("rp:stream/hash_tokens"), \
+                    _stage(self.stats, "hash"), \
+                    hash_threads_override(self.hash_threads):
                 batch = self.hasher.transform_tokens(tokens, indptr, values)
             if batch.shape != (hi - lo, self.n_features):
                 raise ValueError(
@@ -209,6 +249,122 @@ class FaultInjectionSource(RowBatchSource):
                     f"injected fault before batch {i} (row {lo})"
                 )
             yield lo, batch
+
+
+class PrefetchSource(RowBatchSource):
+    """Asynchronous producer stage: run ``inner.iter_batches`` (and an
+    optional ``prepare`` step) on a background worker thread, feeding the
+    consumer through a bounded queue.
+
+    This is the overlapped-ingest pipeline (the r5 perf item): with a
+    ``TokenSource`` inner, murmur3 hashing of batch ``i+1`` runs while the
+    consumer dispatches/fetches batch ``i``; with ``prepare=
+    estimator.prepare_batch``, the H2D upload of batch ``i+1`` is also
+    issued from the worker, so by dispatch time the batch is already
+    device-resident (H2D overlaps device compute instead of sitting in the
+    dispatch path).
+
+    Contract:
+
+    - **Ordering** is the inner source's (one worker, FIFO queue).
+    - **Cursor safety**: prefetch advances only *production*.  Commit
+      (``StreamCursor``) stays with the consumer's ack-after-yield in
+      ``stream_transform``; a batch hashed/uploaded ahead but never
+      consumed is simply recomputed on resume (``iter_batches(start_row)``
+      seeks the inner source, exactly like a fresh run).
+    - **Exception propagation**: a worker-thread failure (source read,
+      hash, prepare) is re-raised in the consumer *after* the batches
+      produced before it — the same prefix-then-raise behavior a serial
+      iteration of the failing source gives, so fault-injection/resume
+      semantics are unchanged.
+    - **Clean shutdown**: closing the generator (consumer ``break``,
+      exception, or GC) stops and joins the worker; no thread outlives the
+      iteration.  ``depth`` bounds host memory at ``depth + 1`` produced
+      batches (queue plus the one in the worker's hands).
+
+    ``stats`` (a ``StreamStats``) records the ``'h2d'`` stage wall for
+    ``prepare`` and a queue-occupancy gauge sampled by the producer at
+    each delivery: max 0 means producer-bound (the consumer always had
+    the queue drained), ``depth`` means the queue was full and the
+    producer had to wait (consumer-bound).
+    """
+
+    _DONE = object()  # worker sentinel: inner iterator exhausted
+    _POLL_S = 0.05  # put/get poll so shutdown never deadlocks on a full/empty queue
+
+    def __init__(self, inner: RowBatchSource, *, depth: int = 2,
+                 prepare: Optional[Callable] = None, stats=None):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._inner = inner
+        self.depth = depth
+        self.prepare = prepare
+        self.stats = stats
+        self.batch_rows = inner.batch_rows
+        self.n_rows = inner.n_rows
+        self.n_features = inner.n_features
+        self.dtype = inner.dtype
+
+    def iter_batches(self, start_row: int = 0):
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            # bounded put that notices shutdown: never blocks forever on a
+            # queue the consumer stopped draining
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=self._POLL_S)
+                    return True
+                except queue.Full:
+                    pass
+            return False
+
+        def work():
+            try:
+                for lo, batch in self._inner.iter_batches(start_row):
+                    if self.prepare is not None:
+                        with _stage(self.stats, "h2d"):
+                            batch = self.prepare(batch)
+                    if self.stats is not None:
+                        # occupancy the producer found at delivery: 0 =
+                        # the consumer had drained the queue (producer-
+                        # bound), depth = full, the producer must wait
+                        # (consumer-bound)
+                        self.stats.on_queue_depth(q.qsize())
+                    if not _put((lo, batch)):
+                        return  # consumer went away
+                _put(self._DONE)
+            except BaseException as e:  # propagate to the consumer thread
+                _put((self._DONE, e))
+
+        worker = threading.Thread(
+            target=work, name="rp-prefetch-worker", daemon=True
+        )
+        worker.start()
+        try:
+            while True:
+                # poll so a worker that died without posting (e.g. killed
+                # interpreter teardown) cannot hang the consumer
+                try:
+                    item = q.get(timeout=self._POLL_S)
+                except queue.Empty:
+                    if worker.is_alive():
+                        continue
+                    try:  # the worker may have posted right before exiting
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        raise RuntimeError(
+                            "prefetch worker died without a result"
+                        ) from None
+                if item is self._DONE:
+                    return
+                if isinstance(item, tuple) and item[0] is self._DONE:
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()
+            worker.join()
 
 
 @dataclasses.dataclass
@@ -280,7 +436,7 @@ def stream_transform(
     def materialize(entry):
         start_row, n_rows, y, in_nbytes = entry
         if not sp.issparse(y):  # forces device→host for lazy handles
-            with annotate("rp:stream/fetch_d2h"):
+            with annotate("rp:stream/fetch_d2h"), _stage(stats, "d2h"):
                 y = np.asarray(y)
             if out_dtype is not None:
                 y = y.astype(out_dtype, copy=False)
@@ -302,18 +458,38 @@ def stream_transform(
         if stats is not None:
             stats.on_commit(start_row, in_nbytes, y)
 
-    for start_row, batch in source.iter_batches(cursor.rows_done):
-        # _transform_async is each estimator's own (possibly overridden)
-        # transform, returning a lazy device handle where supported
-        with annotate("rp:stream/dispatch"):
-            y = estimator._transform_async(batch)
-        # keep only the byte count: retaining the batch itself would pin
-        # pipeline_depth extra input batches of host memory
-        pending.append((start_row, batch.shape[0], y, batch_nbytes(batch)))
-        if len(pending) >= pipeline_depth:
+    batches = source.iter_batches(cursor.rows_done)
+    try:
+        for start_row, batch in batches:
+            # _transform_async is each estimator's own (possibly overridden)
+            # transform, returning a lazy device handle where supported
+            with annotate("rp:stream/dispatch"), _stage(stats, "dispatch"):
+                y = estimator._transform_async(batch)
+            fetch_async = getattr(y, "copy_to_host_async", None)
+            if fetch_async is not None:
+                # start the d2h as soon as the device finishes this batch:
+                # the transfer then overlaps the NEXT batch's compute, and
+                # the blocking np.asarray at emit time reuses the fetched
+                # copy instead of paying the full transfer on the critical
+                # path
+                fetch_async()
+            # keep only the byte count: retaining the batch itself would pin
+            # pipeline_depth extra input batches of host memory
+            pending.append(
+                (start_row, batch.shape[0], y, batch_nbytes(batch))
+            )
+            if len(pending) >= pipeline_depth:
+                yield from emit(pending.pop(0))
+        while pending:
             yield from emit(pending.pop(0))
-    while pending:
-        yield from emit(pending.pop(0))
+    finally:
+        # deterministic producer shutdown: a PrefetchSource's worker thread
+        # must be stopped/joined even when the consumer abandons the stream
+        # mid-flight (break or exception) — relying on GC to close the
+        # generator would leak the thread until collection
+        close = getattr(batches, "close", None)
+        if close is not None:
+            close()
 
 
 def stream_to_memmap(

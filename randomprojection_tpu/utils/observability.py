@@ -10,12 +10,16 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from typing import Optional
 
 logger = logging.getLogger("randomprojection_tpu")
 
-__all__ = ["StreamStats", "batch_nbytes", "profile_trace", "annotate", "logger"]
+__all__ = [
+    "StreamStats", "batch_nbytes", "profile_trace", "annotate", "logger",
+    "stage",
+]
 
 
 def batch_nbytes(batch) -> int:
@@ -25,14 +29,25 @@ def batch_nbytes(batch) -> int:
     exposes no ``.nbytes`` itself — a bare ``getattr(batch, 'nbytes', 0)``
     silently records 0 for every sparse stream.  CSR/CSC/BSR count
     data+indices+indptr, COO data+coords (or row/col on pre-array scipy),
-    DIA data+offsets."""
+    DIA data+offsets.  Formats without flat numeric component arrays
+    (LIL's object-dtype row lists, DOK's dict) are *estimated* as
+    ``nnz · (itemsize + index bytes)`` — counting LIL's ``.data`` directly
+    would record 8 pointer bytes per ROW and DOK would record 0, the very
+    silent-undercount failure this helper exists to prevent (ADVICE r5)."""
     import numpy as np
     import scipy.sparse as sp
 
     if not sp.issparse(batch):
         return int(getattr(batch, "nbytes", 0))
     data = getattr(batch, "data", None)
-    total = int(data.nbytes) if isinstance(data, np.ndarray) else 0
+    if not isinstance(data, np.ndarray) or data.dtype == object:
+        # LIL/DOK: no flat payload arrays to count — estimate the
+        # COO-equivalent payload, one value + a (row, col) intp pair per
+        # stored element
+        return int(batch.nnz) * (
+            np.dtype(batch.dtype).itemsize + 2 * np.dtype(np.intp).itemsize
+        )
+    total = int(data.nbytes)
     coords = getattr(batch, "coords", None)
     if isinstance(coords, tuple):  # COO; .row/.col are views of .coords
         return total + sum(int(c.nbytes) for c in coords)
@@ -43,12 +58,35 @@ def batch_nbytes(batch) -> int:
     return total
 
 
+def stage(stats: Optional["StreamStats"], name: str):
+    """``stats.stage(name)`` when stats is given, else a no-op context —
+    so pipeline stages can be instrumented unconditionally."""
+    if stats is None:
+        return contextlib.nullcontext()
+    return stats.stage(name)
+
+
 class StreamStats:
     """Running counters for a streamed transform.
 
     Pass to ``stream_transform(..., stats=...)``; updated at every commit
     (host materialization), so throughput includes the full h2d → einsum →
     d2h pipeline, not just dispatch.
+
+    Per-stage wall attribution: pipeline stages (``hash`` in ``TokenSource``,
+    ``h2d`` in ``PrefetchSource``'s prepare step, ``dispatch``/``d2h`` in
+    ``stream_transform``) wrap themselves in ``stage(name)``, accumulating
+    wall-clock into ``stage_wall`` under a lock — the producer stages run on
+    the prefetch worker thread, the consumer stages on the caller's, so with
+    an overlapped pipeline the stage walls can legitimately sum to MORE than
+    the end-to-end elapsed time.  That excess is the measured overlap:
+    ``overlap_ratio() = 1 - elapsed / Σ stage_wall`` (clamped at 0) — 0 for
+    a fully serial pipeline, → 0.5 when two equal stages fully overlap.
+    ``on_queue_depth`` is the prefetch queue-occupancy gauge, sampled by
+    the producer at each delivery: a max that sits at 0 means the
+    consumer always had the queue drained (producer-bound stream); the
+    queue capacity means the producer had to wait for space
+    (consumer-bound).
     """
 
     def __init__(self, log_every: int = 0):
@@ -57,6 +95,11 @@ class StreamStats:
         self.rows = 0
         self.bytes_in = 0
         self.bytes_out = 0
+        self.stage_wall: dict = {}
+        self.queue_depth_max = 0
+        self._queue_depth_sum = 0
+        self._queue_depth_n = 0
+        self._lock = threading.Lock()
         self._t0: Optional[float] = None
         self._t_last: Optional[float] = None
 
@@ -82,6 +125,46 @@ class StreamStats:
                 self.batches, self.rows, self.rows_per_s(),
             )
 
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        """Attribute the wrapped region's wall-clock to pipeline stage
+        ``name``.  Thread-safe: producer stages record from the prefetch
+        worker concurrently with the consumer's dispatch/d2h stages."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.stage_wall[name] = self.stage_wall.get(name, 0.0) + dt
+
+    def on_queue_depth(self, depth: int) -> None:
+        """Record one prefetch-queue occupancy sample (taken by the
+        producer at each delivery)."""
+        with self._lock:
+            if depth > self.queue_depth_max:
+                self.queue_depth_max = depth
+            self._queue_depth_sum += depth
+            self._queue_depth_n += 1
+
+    def queue_depth_mean(self) -> float:
+        if not self._queue_depth_n:
+            return 0.0
+        return self._queue_depth_sum / self._queue_depth_n
+
+    def overlap_ratio(self) -> float:
+        """Fraction of attributed stage wall hidden by overlap:
+        ``1 - elapsed / Σ stage_wall``, clamped at 0.  Exactly 0 when the
+        stages ran back-to-back on one thread; approaches ``1 - 1/n`` when
+        ``n`` equal stages run fully concurrently.  Only attributed stages
+        count, so unattributed host work outside any ``stage()`` region
+        biases the ratio DOWN (never fakes overlap)."""
+        total = sum(self.stage_wall.values())
+        elapsed = self.elapsed_s()
+        if total <= 0.0 or elapsed <= 0.0:
+            return 0.0
+        return max(0.0, 1.0 - elapsed / total)
+
     def elapsed_s(self) -> float:
         if self._t0 is None or self._t_last is None:
             return 0.0
@@ -91,7 +174,7 @@ class StreamStats:
         return self.rows / self.elapsed_s() if self.rows else 0.0
 
     def summary(self) -> dict:
-        return {
+        out = {
             "batches": self.batches,
             "rows": self.rows,
             "bytes_in": self.bytes_in,
@@ -99,6 +182,15 @@ class StreamStats:
             "elapsed_s": round(self.elapsed_s(), 4),
             "rows_per_s": round(self.rows_per_s(), 1),
         }
+        if self.stage_wall:
+            out["stage_wall_s"] = {
+                k: round(v, 4) for k, v in sorted(self.stage_wall.items())
+            }
+            out["pipeline_overlap_ratio"] = round(self.overlap_ratio(), 3)
+        if self._queue_depth_n:
+            out["queue_depth_max"] = self.queue_depth_max
+            out["queue_depth_mean"] = round(self.queue_depth_mean(), 2)
+        return out
 
 
 @contextlib.contextmanager

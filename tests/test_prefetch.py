@@ -1,0 +1,412 @@
+"""Overlapped-ingest pipeline tests: ``PrefetchSource`` ordering /
+cursor-resume / shutdown semantics, multi-threaded hashing determinism,
+early-H2D prepared batches, and the per-stage observability (ISSUE r6)."""
+
+import threading
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from randomprojection_tpu import GaussianRandomProjection
+from randomprojection_tpu.models.sketch import CountSketch, DeviceBatch
+from randomprojection_tpu.ops.hashing import hash_threads_override
+from randomprojection_tpu.streaming import (
+    ArraySource,
+    FaultInjectionSource,
+    PrefetchSource,
+    StreamCursor,
+    TokenSource,
+    stream_transform,
+)
+from randomprojection_tpu.utils.observability import StreamStats, batch_nbytes
+
+
+def prefetch_threads():
+    return [
+        t for t in threading.enumerate() if t.name.startswith("rp-prefetch")
+    ]
+
+
+@pytest.fixture
+def X():
+    return np.random.default_rng(0).normal(size=(1000, 128)).astype(np.float32)
+
+
+def make_est(backend="numpy", k=16):
+    return GaussianRandomProjection(
+        n_components=k, random_state=0, backend=backend
+    )
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_prefetch_matches_serial(X, backend, depth):
+    """Prefetching must change WHEN batches are produced, never their
+    order or values."""
+    est = make_est(backend).fit(X)
+    ref = list(est.transform_stream(ArraySource(X, 128)))
+    got = list(
+        est.transform_stream(
+            PrefetchSource(
+                ArraySource(X, 128), depth=depth, prepare=est.prepare_batch
+            )
+        )
+    )
+    assert [lo for lo, _ in got] == [lo for lo, _ in ref]
+    np.testing.assert_array_equal(
+        np.concatenate([y for _, y in got]),
+        np.concatenate([y for _, y in ref]),
+    )
+    assert not prefetch_threads()
+
+
+def test_prefetch_depth_validation(X):
+    with pytest.raises(ValueError, match="depth"):
+        PrefetchSource(ArraySource(X, 128), depth=0)
+
+
+def test_prefetch_fault_resume_bit_identical(X, tmp_path):
+    """A worker-thread failure (fault-injected source) must propagate to
+    the consumer after the batches produced before it — no hang, no leaked
+    thread — and the checkpoint resume must be bit-identical, exactly as
+    the serial source behaves."""
+    ckpt = str(tmp_path / "cursor.json")
+    est = make_est().fit(X)
+    Y_ref = np.concatenate(
+        [y for _, y in est.transform_stream(ArraySource(X, 128))]
+    )
+
+    inner = FaultInjectionSource(ArraySource(X, 128), fail_after_batches=3)
+    src = PrefetchSource(inner, depth=2)
+    got = []
+    with pytest.raises(FaultInjectionSource.InjectedFault):
+        for lo, y in est.transform_stream(src, checkpoint_path=ckpt):
+            got.append((lo, y))
+    assert not prefetch_threads(), "worker must be joined after the failure"
+    committed = StreamCursor.load(ckpt).rows_done
+    assert committed == sum(y.shape[0] for _, y in got)
+    assert 0 < committed < 1000
+
+    inner.disarm()
+    for lo, y in est.transform_stream(src, checkpoint_path=ckpt):
+        assert lo == committed, "resume must continue at the cursor"
+        committed += y.shape[0]
+        got.append((lo, y))
+    np.testing.assert_array_equal(
+        np.concatenate([y for _, y in got]), Y_ref
+    )
+    assert not prefetch_threads()
+
+
+def test_prefetch_worker_exception_in_prepare_propagates(X):
+    """A failure in the prepare step (worker thread) must surface in the
+    consumer, not hang the stream."""
+
+    class PrepareBoom(RuntimeError):
+        pass
+
+    def bad_prepare(batch):
+        raise PrepareBoom("prepare failed")
+
+    est = make_est().fit(X)
+    with pytest.raises(PrepareBoom):
+        list(
+            est.transform_stream(
+                PrefetchSource(ArraySource(X, 128), depth=2,
+                               prepare=bad_prepare)
+            )
+        )
+    assert not prefetch_threads()
+
+
+def test_prefetch_consumer_break_joins_worker(X):
+    """Abandoning the stream mid-flight (break) must stop and join the
+    worker thread — no thread outlives the iteration."""
+    est = make_est().fit(X)
+    for i, (lo, y) in enumerate(
+        est.transform_stream(PrefetchSource(ArraySource(X, 128), depth=2))
+    ):
+        if i == 1:
+            break
+    assert not prefetch_threads()
+
+
+def test_prefetch_consumer_crash_does_not_commit_inflight(X, tmp_path):
+    """Ack-after-yield survives prefetching: batches hashed/produced ahead
+    by the worker are NOT committed until the consumer has processed them
+    — a crash inside the consumer's write leaves the in-flight batch
+    uncommitted, so resume re-yields it."""
+    ckpt = str(tmp_path / "cursor.json")
+    est = make_est().fit(X)
+    Y_ref = np.concatenate(
+        [y for _, y in est.transform_stream(ArraySource(X, 128))]
+    )
+
+    class ConsumerCrash(RuntimeError):
+        pass
+
+    written = {}
+    with pytest.raises(ConsumerCrash):
+        for lo, y in est.transform_stream(
+            PrefetchSource(ArraySource(X, 128), depth=4),
+            checkpoint_path=ckpt,
+        ):
+            if lo == 256:
+                raise ConsumerCrash("crash before persisting this batch")
+            written[lo] = y
+    assert not prefetch_threads()
+    assert StreamCursor.load(ckpt).rows_done == 256, (
+        "the worker had prefetched past row 256, but only consumer-acked "
+        "batches may commit"
+    )
+    for lo, y in est.transform_stream(
+        PrefetchSource(ArraySource(X, 128), depth=4), checkpoint_path=ckpt
+    ):
+        written[lo] = y
+    np.testing.assert_array_equal(
+        np.concatenate([written[lo] for lo in sorted(written)]), Y_ref
+    )
+
+
+def test_prefetch_schema_delegates(X):
+    src = PrefetchSource(ArraySource(X, 128), depth=2)
+    assert src.schema() == ArraySource(X, 128).schema()
+    assert src.batch_rows == 128
+
+
+def test_hash_threads_bit_identical():
+    """The C++ batch hasher must be bit-identical at any worker count
+    (token i's outputs depend only on token i).  Uses >= 2^18 tokens so
+    the threaded path actually engages (native/murmur3.cpp gate)."""
+    from randomprojection_tpu.native.build import load_murmur3
+    from randomprojection_tpu.ops.hashing import hash_tokens
+
+    if load_murmur3() is None:  # pragma: no cover - no-compiler envs
+        pytest.skip("native murmur3 unavailable; only the serial path exists")
+    words = np.asarray([f"tok{i}" for i in range(50_000)])
+    toks = words[
+        np.random.default_rng(7).integers(0, len(words), size=1 << 18)
+    ]
+    with hash_threads_override(1):
+        idx1, sign1 = hash_tokens(toks, 1 << 20)
+    with hash_threads_override(4):
+        idx4, sign4 = hash_tokens(toks, 1 << 20)
+    np.testing.assert_array_equal(idx1, idx4)
+    np.testing.assert_array_equal(sign1, sign4)
+
+
+def test_hash_threads_override_scoping(monkeypatch):
+    """With the explicit-thread ABI the override is THREAD-LOCAL (no env
+    mutation — concurrent streams can't leak into each other); a legacy
+    .so falls back to a locked env override that always restores."""
+    import os
+
+    from randomprojection_tpu.native.build import load_murmur3
+    from randomprojection_tpu.ops import hashing as h
+
+    lib = load_murmur3()
+    if lib is not None and getattr(lib, "has_explicit_threads", False):
+        monkeypatch.setenv("RP_HASH_THREADS", "1")
+        with hash_threads_override(3):
+            assert os.environ["RP_HASH_THREADS"] == "1", "env must not move"
+            assert h._requested_threads(None) == 3
+        assert h._requested_threads(None) == 0
+        # a sibling thread must not see this thread's override
+        seen = {}
+        with hash_threads_override(3):
+            t = threading.Thread(
+                target=lambda: seen.setdefault(
+                    "n", h._requested_threads(None)
+                )
+            )
+            t.start()
+            t.join()
+        assert seen["n"] == 0
+
+    # legacy path (forced): env override, set and restored
+    monkeypatch.setattr(h, "_explicit_threads_supported", lambda: False)
+    monkeypatch.setenv("RP_HASH_THREADS", "1")
+    with hash_threads_override(3):
+        assert os.environ["RP_HASH_THREADS"] == "3"
+    assert os.environ["RP_HASH_THREADS"] == "1"
+    monkeypatch.delenv("RP_HASH_THREADS")
+    with hash_threads_override(2):
+        assert os.environ["RP_HASH_THREADS"] == "2"
+    assert "RP_HASH_THREADS" not in os.environ
+    with pytest.raises(ValueError):
+        hash_threads_override(0).__enter__()
+
+
+def test_token_source_hash_threads_param():
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+
+    fh = FeatureHasher(1 << 10, input_type="string", dtype=np.float32)
+
+    def read_tokens(lo, hi):
+        return (
+            np.asarray([f"t{i}" for i in range(lo, hi)]),
+            np.arange(0, hi - lo + 1),
+        )
+
+    with pytest.raises(ValueError, match="hash_threads"):
+        TokenSource(read_tokens, 8, fh, batch_rows=4, hash_threads=0)
+    ref = [b for _, b in TokenSource(read_tokens, 8, fh, 4).iter_batches()]
+    got = [
+        b
+        for _, b in TokenSource(
+            read_tokens, 8, fh, 4, hash_threads=2
+        ).iter_batches()
+    ]
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r.toarray(), g.toarray())
+
+
+def test_countsketch_prepare_batch_device_path():
+    """prepare_batch must route exactly like _transform_csr_jax (doc-major
+    for low-skew, flat for skewed), return device-resident batches, and
+    the dispatched results must match the unprepared path bit-for-bit."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(100, 400)).astype(np.float32)
+    X[np.abs(X) < 1.0] = 0.0
+    Xs = sp.csr_array(X)
+    cs = CountSketch(32, random_state=0, backend="jax").fit_schema(
+        *Xs.shape, np.float32
+    )
+    b = cs.prepare_batch(Xs)
+    assert isinstance(b, DeviceBatch) and b.kind == "docmajor"
+    assert b.shape == Xs.shape and b.nbytes == batch_nbytes(Xs)
+    ref = np.asarray(cs._transform_csr_jax(Xs))
+    np.testing.assert_array_equal(
+        np.asarray(cs._transform_async(b)), ref
+    )
+
+    # a single huge row forces the flat kernel on both paths
+    wide = sp.csr_array(
+        (
+            np.ones(4096, np.float32),
+            rng.integers(0, 400, 4096),
+            np.asarray([0, 4096] + [4096] * 7),
+        ),
+        shape=(8, 400),
+    )
+    bw = cs.prepare_batch(wide)
+    assert isinstance(bw, DeviceBatch) and bw.kind == "flat"
+    np.testing.assert_array_equal(
+        np.asarray(cs._transform_async(bw)),
+        np.asarray(cs._transform_csr_jax(wide)),
+    )
+
+    # host-path batches pass through unchanged
+    assert cs.prepare_batch(Xs.astype(np.float64)) is not b
+    assert not isinstance(
+        cs.prepare_batch(Xs.astype(np.float64)), DeviceBatch
+    )
+    cs_np = CountSketch(32, random_state=0, backend="numpy").fit_schema(
+        *Xs.shape, np.float32
+    )
+    assert not isinstance(cs_np.prepare_batch(Xs), DeviceBatch)
+
+
+def test_countsketch_prefetch_stream_matches_numpy_reference():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(300, 256)).astype(np.float32)
+    X[np.abs(X) < 1.0] = 0.0
+    Xs = sp.csr_array(X)
+    cs = CountSketch(16, random_state=0, backend="jax").fit_schema(
+        *Xs.shape, np.float32
+    )
+    got = np.concatenate(
+        [
+            np.asarray(y)
+            for _, y in stream_transform(
+                cs,
+                PrefetchSource(
+                    ArraySource(Xs, 64), depth=2, prepare=cs.prepare_batch
+                ),
+            )
+        ]
+    )
+    ref = (
+        CountSketch(16, random_state=0, backend="numpy")
+        .fit(Xs)
+        .transform(Xs.astype(np.float64))
+    )
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_jl_prepare_batch_uploads_device_array(X):
+    est = make_est("jax").fit(X)
+    prepared = est.prepare_batch(X[:128])
+    import jax
+
+    assert isinstance(prepared, jax.Array)
+    np.testing.assert_array_equal(
+        np.asarray(est._transform_async(prepared)),
+        np.asarray(est.transform(X[:128])),
+    )
+    # numpy backend: no-op hook
+    est_np = make_est("numpy").fit(X)
+    assert est_np.prepare_batch(X[:128]) is X[:128] or isinstance(
+        est_np.prepare_batch(X[:128]), np.ndarray
+    )
+
+
+def test_stream_stats_stage_attribution_and_queue_gauge():
+    """The token pipeline under PrefetchSource must attribute wall to the
+    hash/h2d/dispatch/d2h stages, sample queue occupancy, and report a
+    clamped overlap ratio."""
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+
+    words = np.asarray([f"w{i}" for i in range(2000)])
+
+    def read_tokens(lo, hi):
+        rngs = [np.random.default_rng(500 + i) for i in range(lo, hi)]
+        toks = np.concatenate(
+            [words[r.integers(0, len(words), size=10)] for r in rngs]
+        )
+        return toks, np.arange(0, (hi - lo) * 10 + 1, 10)
+
+    fh = FeatureHasher(1 << 14, input_type="string", dtype=np.float32)
+    stats = StreamStats()
+    source = PrefetchSource(
+        TokenSource(
+            read_tokens, 128, fh, batch_rows=32, hash_threads=2, stats=stats
+        ),
+        depth=2, stats=stats,
+    )
+    cs = CountSketch(16, random_state=0, backend="jax").fit_source(source)
+    rows = 0
+    for _, y in stream_transform(cs, source, stats=stats):
+        rows += y.shape[0]
+    assert rows == 128
+    assert {"hash", "dispatch", "d2h"} <= set(stats.stage_wall)
+    assert all(v >= 0 for v in stats.stage_wall.values())
+    assert 0.0 <= stats.overlap_ratio() < 1.0
+    # one producer-side occupancy sample per delivered batch
+    assert stats._queue_depth_n == 4
+    assert 0 <= stats.queue_depth_max <= 2
+    s = stats.summary()
+    assert "stage_wall_s" in s and "pipeline_overlap_ratio" in s
+    assert s["queue_depth_max"] == stats.queue_depth_max
+
+
+def test_batch_nbytes_lil_dok_regression():
+    """ADVICE r5: LIL's object-dtype .data counted 8 pointer bytes per row
+    and DOK counted 0 — both must report a real payload estimate now."""
+    dense = np.zeros((64, 32), dtype=np.float32)
+    dense[::2, ::4] = 1.5
+    lil = sp.lil_array(dense)
+    dok = sp.dok_array(dense)
+    # COO-equivalent estimate: value + (row, col) intp pair per element
+    want = int(dense.astype(bool).sum()) * (
+        np.dtype(np.float32).itemsize + 2 * np.dtype(np.intp).itemsize
+    )
+    assert batch_nbytes(lil) == want
+    assert batch_nbytes(dok) == want
+    # CSR stays the exact component count, dense the ndarray nbytes
+    csr = sp.csr_array(dense)
+    assert batch_nbytes(csr) == (
+        csr.data.nbytes + csr.indices.nbytes + csr.indptr.nbytes
+    )
+    assert batch_nbytes(dense) == dense.nbytes

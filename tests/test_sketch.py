@@ -375,3 +375,41 @@ def test_countsketch_csr_docmajor_mesh_matches(monkeypatch):
     np.testing.assert_allclose(Ym, Y1, rtol=1e-6, atol=1e-6)
     Yn = CountSketch(32, random_state=0, backend="numpy").fit(Xs).transform(Xs)
     np.testing.assert_allclose(Ym, Yn, rtol=2e-5, atol=2e-5)
+
+
+def test_simhash_index_int32_id_guard():
+    """ADVICE r5: device-side ids are int32 end to end, so the index must
+    refuse to grow past 2^31 - 1 codes instead of silently wrapping global
+    ids in query_topk."""
+    from randomprojection_tpu.models.sketch import SimHashIndex
+
+    codes = np.random.default_rng(0).integers(
+        0, 256, size=(16, 8), dtype=np.uint8
+    )
+    idx = SimHashIndex(codes)
+    idx.n_codes = 2**31 - 10  # simulate a near-capacity index
+    with pytest.raises(ValueError, match="2\\*\\*31"):
+        idx.add(codes)
+    assert idx.n_codes == 2**31 - 10, "a refused add must not mutate state"
+
+
+def test_query_topk_dense_fallback_when_key_overflows(monkeypatch):
+    """ADVICE r5: when the int32 key packing cannot represent a request
+    (huge m / very wide codes), query_topk must serve it through the dense
+    query() + host-selection path — same results and tie order — instead
+    of raising."""
+    from randomprojection_tpu.models import sketch as sk
+
+    rng = np.random.default_rng(11)
+    B = rng.integers(0, 256, size=(96, 8), dtype=np.uint8)
+    A = rng.integers(0, 256, size=(7, 8), dtype=np.uint8)
+    idx = sk.SimHashIndex(B)
+    ref_d, ref_i = idx.query_topk(A, 5)
+
+    monkeypatch.setattr(sk, "_topk_key_fits_int32", lambda *a: False)
+    got_d, got_i = idx.query_topk(A, 5)
+    np.testing.assert_array_equal(got_d, ref_d)
+    np.testing.assert_array_equal(got_i, ref_i)
+    brute_d, brute_i = sk.topk_bruteforce(A, B, 5)
+    np.testing.assert_array_equal(got_d, brute_d)
+    np.testing.assert_array_equal(got_i, brute_i)
