@@ -1,4 +1,12 @@
-"""Benchmark harness: the north-star metric as one JSON line on stdout.
+"""Benchmark harness: the north-star metric as JSON on stdout.
+
+Output contract (tail-safe since r7): the FULL record is printed first as
+one JSON line, then a self-contained ≤2 KB compact digest (headline mode
+record, per-mode/per-config digests, and the round-over-round
+``regressions`` tripwire computed against the newest committed
+``BENCH_r*.json``) is printed as the FINAL line — the driver's tail
+capture can truncate the multi-KB full line (it did in r5, losing the
+flagship headline) but never the last 2 KB.
 
 Metric (BASELINE.json:2): rows/sec/chip projecting 4096→256 over 1M rows,
 plus pairwise-distance distortion vs the CPU reference.  Reported number is

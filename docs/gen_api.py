@@ -79,7 +79,7 @@ def main():
     import randomprojection_tpu.parallel as parallel
     from randomprojection_tpu.ops import hashing, pallas_kernels, split_matmul
     from randomprojection_tpu.parallel import distributed
-    from randomprojection_tpu.utils import observability
+    from randomprojection_tpu.utils import observability, telemetry
 
     for title, mod in [
         ("`randomprojection_tpu.streaming`", streaming),
@@ -90,6 +90,7 @@ def main():
         ("`randomprojection_tpu.ops.pallas_kernels`", pallas_kernels),
         ("`randomprojection_tpu.ops.split_matmul`", split_matmul),
         ("`randomprojection_tpu.utils.observability`", observability),
+        ("`randomprojection_tpu.utils.telemetry`", telemetry),
     ]:
         lines += [f"## {title}", ""]
         for name in getattr(mod, "__all__", []):

@@ -525,11 +525,19 @@ class JaxBackend(ProjectionBackend):
         )
 
     def _transform_impl(self, X, state, spec: ProjectionSpec):
+        from randomprojection_tpu.utils import telemetry
         from randomprojection_tpu.utils.observability import annotate
 
         x, n, device_resident = self._prepare_rows(
             X, allow_bf16=spec.dtype == "bfloat16"
         )
+        telemetry.registry().counter_inc("backend.dispatches")
+        if telemetry.enabled():
+            telemetry.emit(
+                "backend.dispatch", kind=spec.kind, rows=int(n),
+                n_features=spec.n_features, n_components=spec.n_components,
+                device_resident=bool(device_resident),
+            )
         with annotate("rp:backend/project"):
             return self._project_prepared(x, n, state, spec), device_resident
 
@@ -578,6 +586,9 @@ class JaxBackend(ProjectionBackend):
 
                     if not is_vmem_oom(e):
                         raise
+                    from randomprojection_tpu.ops.pallas_kernels import (
+                        record_vmem_oom_retry,
+                    )
                     from randomprojection_tpu.utils.observability import (
                         logger,
                     )
@@ -586,6 +597,9 @@ class JaxBackend(ProjectionBackend):
                         "fused lazy kernel hit a scoped-VMEM limit for "
                         "shape %s; retrying without the in-VMEM mask cache "
                         "(regenerate-every-step degradation)", shape_key,
+                    )
+                    record_vmem_oom_retry(
+                        xc.shape, mxu_mode, spec.n_components
                     )
                     y = self._get_lazy_mesh_fn(
                         state, spec, mxu_mode, no_cache=True

@@ -9,13 +9,27 @@ must not be conflated).
 
 from __future__ import annotations
 
+import glob
 import json
+import os
+import re
 import time
+from typing import Optional
 
 import numpy as np
 
 V5E_PEAK_TFLOPS = 197.0
 DISTORTION_BUDGET = 1e-3
+
+# compact-summary line: marker key + schema version, and the byte budget
+# the driver's tail capture is guaranteed to keep intact (the driver keeps
+# the TAIL of stdout, so the LAST line survives any truncation — r5 lost
+# the flagship headline because the one bench line was multi-KB and was
+# truncated from the front)
+COMPACT_MARKER = "rp_bench_compact"
+COMPACT_SCHEMA_VERSION = 1
+COMPACT_MAX_BYTES = 2048
+REGRESSION_THRESHOLD = 0.10
 
 PRESETS = {
     # batch rows, scan steps per call, timed calls.  Steps-per-call is high
@@ -391,11 +405,23 @@ def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
         # the overlapped pipeline cannot outrun its slowest stage: flag a
         # cache-served sample that beats the device sketch measured in the
         # SAME run, or the threaded-hash ceiling
+        # the C++ kernel clamps effective workers to n_tokens >> 16
+        # (native/murmur3.cpp::hash_worker_count), so a many-core host's
+        # os.cpu_count() must not inflate the ceiling ~5x and blind the
+        # suspect flag to cache-served samples
+        batch_tokens = 8192 * tok_per_doc
+        eff_hash_threads = min(hash_threads, max(1, batch_tokens >> 16))
         pipe_ceiling = min(
             docs_per_s,
-            ingest_stats["best"] * hash_threads / tok_per_doc,
+            ingest_stats["best"] * eff_hash_threads / tok_per_doc,
         )
         pipe_suspect = bool(e2e > 1.2 * pipe_ceiling)
+        # the serial loop is hash-pinned to 1 thread and fully
+        # serialized, so it cannot outrun EITHER of its stages — its own
+        # independent suspect flag (the regression tripwire gates the
+        # serial rate on this, not on the pipelined run's flag)
+        serial_ceiling = min(docs_per_s, ingest_stats["best"] / tok_per_doc)
+        serial_suspect = bool(e2e_serial > 1.2 * serial_ceiling)
     finally:
         if prev is None:
             os.environ.pop("RP_HASH_THREADS", None)
@@ -426,6 +452,7 @@ def measure_config5(n_docs: int = 65536, tok_per_doc: int = 100,
         "sketch_instrument": "per_batch_chained",
         "end_to_end_docs_per_s": round(e2e, 1),
         "end_to_end_serial_docs_per_s": round(e2e_serial, 1),
+        "serial_timing_suspect": serial_suspect,
         "pipeline_overlap_ratio": round(stats.overlap_ratio(), 3),
         "pipeline_stage_wall_s": {
             name: round(wall, 4)
@@ -730,6 +757,359 @@ def measure_config4_topk(preset: str = "full") -> dict:
     }
 
 
+# -- bench-record loading (shared with docs/gen_bench_tables.py) ------------
+
+
+def _balanced_json(text: str, start: int) -> str:
+    """The {...} object starting at ``text[start]`` (balanced braces; the
+    bench JSON contains no braces inside strings)."""
+    depth = 0
+    for i in range(start, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start : i + 1]
+    raise ValueError("unbalanced JSON object")
+
+
+def find_compact_line(text: str) -> Optional[dict]:
+    """The LAST compact-summary object embedded in ``text`` (the driver's
+    tail capture keeps the end of stdout, so when both the full record and
+    the compact line survive, the compact line is the later, authoritative
+    one for its keys).  None when no intact compact object is present."""
+    found = None
+    for m in re.finditer(r'\{"%s"' % COMPACT_MARKER, text):
+        try:
+            obj = json.loads(_balanced_json(text, m.start()))
+        except ValueError:
+            continue
+        if obj.get(COMPACT_MARKER) == COMPACT_SCHEMA_VERSION:
+            found = obj
+    return found
+
+
+def recover_bench_tail(tail: str) -> dict:
+    """Rebuild the usable record from a FRONT-TRUNCATED bench line (the
+    driver keeps only the tail of the output): every per-mode dict and
+    every configN dict is extracted by key with balanced braces, and the
+    headline is re-derived from the recovered modes with the bench's own
+    ``select_headline`` — nothing is guessed."""
+    out: dict = {"all_modes": {}}
+    for m in re.finditer(r'"(\w+)":\s*(\{"rows_per_s")', tail):
+        name = m.group(1)
+        obj = json.loads(_balanced_json(tail, m.start(2)))
+        if "distortion" in obj and "timing_suspect" in obj:
+            out["all_modes"][name] = obj
+        elif name.startswith("config"):
+            out[name] = obj
+    for m in re.finditer(r'"(config\d)":\s*(\{)', tail):
+        if m.group(1) not in out:
+            out[m.group(1)] = json.loads(_balanced_json(tail, m.start(2)))
+    if not out["all_modes"] and not any(
+        k.startswith("config") for k in out
+    ):
+        raise ValueError("nothing recoverable from the bench tail")
+    if out["all_modes"]:
+        head = select_headline(out["all_modes"])
+        out.setdefault("mode", head)
+        out.setdefault("value", out["all_modes"][head]["rows_per_s"])
+        out.setdefault(
+            "distortion_eps_vs_cpu", out["all_modes"][head]["distortion"]
+        )
+        # the re-derived headline inherits its mode's OWN suspect flag —
+        # an all-suspect run must not become a trusted tripwire baseline
+        out.setdefault(
+            "timing_suspect", out["all_modes"][head]["timing_suspect"]
+        )
+        out.setdefault("metric", f"rows/sec/chip (headline mode {head})")
+    out["_recovered_from_truncated_tail"] = True
+    return out
+
+
+def load_bench_record(path: str) -> dict:
+    """Load one committed ``BENCH_r*.json`` into a bench record dict.
+
+    Handles every committed shape: a bare record, the driver wrapper
+    ``{n, cmd, rc, tail, parsed}`` with a parsed record, and a wrapper
+    whose ``parsed`` is null — there the tail is scanned for, in order of
+    preference, an intact full record line, the COMPACT summary line
+    (tail-safe by construction: the final ≤2 KB stdout line), and last
+    the balanced-brace recovery of a front-truncated full line."""
+    with open(path) as f:
+        j = json.load(f)
+    if "parsed" not in j:
+        return j
+    parsed = j["parsed"]
+    if parsed and COMPACT_MARKER not in parsed:
+        return parsed
+    # parsed is null OR the driver parsed the (final) compact line: the
+    # richer full record may still sit intact in the tail — prefer it
+    tail = j.get("tail", "")
+    for m in re.finditer(r'\{"metric"', tail):
+        try:
+            obj = json.loads(_balanced_json(tail, m.start()))
+        except ValueError:
+            continue
+        # the records themselves now EMBED {"metric": ...} objects (the
+        # regressions entries), so a bare '{"metric"' match is not enough
+        # — only an object carrying the record's own top-level keys is an
+        # intact full record
+        if "all_modes" in obj or "value" in obj:
+            return obj
+    compact = find_compact_line(tail) or (parsed if parsed else None)
+    if compact is not None:
+        rec = dict(compact)
+        # normalize: older compact lines may lack the headline distortion
+        # key — derive it from the headline mode's own digest so renderers
+        # can rely on the full-record headline fields
+        head = (rec.get("all_modes") or {}).get(rec.get("mode"))
+        if head is not None:
+            rec.setdefault("distortion_eps_vs_cpu", head.get("distortion"))
+            rec.setdefault("value", head.get("rows_per_s"))
+            rec.setdefault("timing_suspect", head.get("timing_suspect"))
+        rec["_from_compact_summary"] = True
+        return rec
+    return recover_bench_tail(tail)
+
+
+def committed_bench_paths(root: Optional[str] = None) -> list:
+    """All committed ``BENCH_r*.json`` paths, oldest → newest (the zero-
+    padded round numbers make the lexicographic sort chronological)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def newest_committed_bench(root: Optional[str] = None) -> Optional[str]:
+    """Path of the newest committed ``BENCH_r*.json`` (None outside a
+    checkout)."""
+    files = committed_bench_paths(root)
+    return files[-1] if files else None
+
+
+# -- regression tripwire -----------------------------------------------------
+
+
+def bench_rates(record: dict) -> dict:
+    """Every comparable throughput in a bench record, as
+    ``{metric_name: (value, suspect)}`` — suspect carries the record's own
+    self-flagging (``timing_suspect`` / ``host_suspect`` / pipeline
+    flags), so the tripwire never condemns a number the record itself
+    already disowned, and never trusts one either."""
+    rates: dict = {}
+
+    def put(name, container, key, suspect_key, default_suspect=False):
+        if not isinstance(container, dict):
+            return
+        v = container.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            rates[name] = (
+                float(v), bool(container.get(suspect_key, default_suspect))
+            )
+
+    put("headline.rows_per_s", record, "value", "timing_suspect")
+    for n, r in (record.get("all_modes") or {}).items():
+        put(f"mode.{n}.rows_per_s", r, "rows_per_s", "timing_suspect")
+    put("config1.rows_per_s", record.get("config1"), "rows_per_s",
+        "host_suspect")
+    put("config3.rows_per_s", record.get("config3"), "rows_per_s",
+        "timing_suspect")
+    c4 = record.get("config4")
+    put("config4.rows_per_s", c4, "rows_per_s", "timing_suspect")
+    put("config4.raw_kernel_rows_per_s", c4, "raw_kernel_rows_per_s",
+        "timing_suspect")
+    if isinstance(c4, dict):
+        put("config4.topk.queries_per_s", c4.get("topk_serving"),
+            "queries_per_s", "timing_suspect")
+        if "config4.topk.queries_per_s" not in rates:
+            # compact-line records flatten topk_serving.queries_per_s to
+            # topk_queries_per_s (suspect flag: topk_timing_suspect) — a
+            # previous round that survived only as its compact line must
+            # still gate the serving rate
+            put("config4.topk.queries_per_s", c4, "topk_queries_per_s",
+                "topk_timing_suspect")
+    c5 = record.get("config5")
+    put("config5.ingest_tokens_per_s", c5, "ingest_tokens_per_s",
+        "ingest_host_suspect")
+    put("config5.device_sketch_docs_per_s", c5, "device_sketch_docs_per_s",
+        "sketch_timing_suspect")
+    put("config5.end_to_end_docs_per_s", c5, "end_to_end_docs_per_s",
+        "pipeline_timing_suspect")
+    put("config5.end_to_end_serial_docs_per_s", c5,
+        "end_to_end_serial_docs_per_s", "serial_timing_suspect")
+    return rates
+
+
+def compute_regressions(current: dict, previous: dict,
+                        threshold: float = REGRESSION_THRESHOLD) -> list:
+    """Rates in ``current`` that dropped more than ``threshold`` vs
+    ``previous``, skipping any rate either side self-flagged as suspect —
+    the config-3-style silent 13% decay (VERDICT r5) becomes a recorded
+    ``regressions`` entry instead of a diff archaeology exercise."""
+    cur, prev = bench_rates(current), bench_rates(previous)
+    out = []
+    for name in sorted(cur):
+        if name not in prev:
+            continue
+        cv, c_sus = cur[name]
+        pv, p_sus = prev[name]
+        if c_sus or p_sus:
+            continue
+        drop = 1.0 - cv / pv
+        if drop > threshold:
+            out.append({
+                "metric": name,
+                "previous": round(pv, 1),
+                "current": round(cv, 1),
+                "drop_pct": round(100.0 * drop, 1),
+            })
+    # the headline IS one of the modes: when the same mode headlines both
+    # rounds, its per-mode entry already carries the drop — listing the
+    # identical numbers twice is noise.  A headline-mode CHANGE keeps the
+    # headline entry (the flagship rate moved for selection reasons worth
+    # flagging even if every individual mode improved).
+    mode = current.get("mode")
+    if mode and mode == previous.get("mode") and any(
+        r["metric"] == f"mode.{mode}.rows_per_s" for r in out
+    ):
+        out = [r for r in out if r["metric"] != "headline.rows_per_s"]
+    out.sort(key=lambda r: -r["drop_pct"])
+    return out
+
+
+def attach_regressions(record: dict, root: Optional[str] = None) -> dict:
+    """Add the ``regressions`` / ``regressions_vs`` keys to a fresh record
+    by comparing against the newest committed ``BENCH_r*.json``.  Only a
+    full-preset default-shape run is comparable to the committed records;
+    anything else gets an empty list with the skip reason on file."""
+    record.setdefault("regressions", [])
+    record.setdefault("regressions_vs", None)
+    if record.get("preset") != "full" or record.get("shape_is_default") is False:
+        record["regressions_skipped"] = (
+            "only full-preset default-shape runs are comparable to the "
+            "committed records"
+        )
+        return record
+    paths = committed_bench_paths(root)
+    if not paths:
+        record["regressions_skipped"] = "no committed BENCH_r*.json found"
+        return record
+    # newest usable record wins: a round whose bench crashed (garbage
+    # tail) must not turn the tripwire off — fall back to the next-newest
+    # intact record instead of going silently dark
+    for path in reversed(paths):
+        try:
+            prev = load_bench_record(path)
+        except (ValueError, json.JSONDecodeError):
+            continue
+        if not bench_rates(prev):
+            continue  # parsed, but nothing comparable in it
+        record["regressions"] = compute_regressions(record, prev)
+        record["regressions_vs"] = os.path.basename(path)
+        record.pop("regressions_skipped", None)
+        return record
+    record["regressions_skipped"] = (
+        "no committed BENCH_r*.json is parseable with comparable rates"
+    )
+    return record
+
+
+# -- tail-safe compact summary -----------------------------------------------
+
+
+def _sig(v, digits: int = 4):
+    """Round to ``digits`` significant figures (compact-line byte budget)."""
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return v
+    return float(f"{float(v):.{digits}g}")
+
+
+def compact_summary(record: dict) -> dict:
+    """The ≤2 KB digest printed as the FINAL stdout line of the bench.
+
+    Self-contained: headline mode record, per-mode digests (rows/s,
+    distortion, suspect), per-config digests, and the ``regressions``
+    tripwire output — everything a reader (or ``gen_bench_tables``) needs
+    when the multi-KB full record line is tail-truncated.  Key names
+    mirror the full record so downstream loaders treat a compact record
+    as a pruned full one.  If an unexpectedly fat payload would exceed
+    the byte budget, the largest optional sections are dropped (never
+    the headline or ``regressions``) and the drop is recorded.
+    """
+    c: dict = {COMPACT_MARKER: COMPACT_SCHEMA_VERSION}
+    for k in ("metric", "mode", "unit", "preset"):
+        if record.get(k) is not None:
+            c[k] = record[k]
+    for k in ("value", "vs_baseline", "distortion_eps_vs_cpu"):
+        if record.get(k) is not None:
+            c[k] = _sig(record[k])
+    if record.get("timing_suspect") is not None:
+        c["timing_suspect"] = bool(record["timing_suspect"])
+    modes = record.get("all_modes") or {}
+    if modes:
+        c["all_modes"] = {
+            n: {
+                "rows_per_s": _sig(r.get("rows_per_s")),
+                "distortion": _sig(r.get("distortion"), 3),
+                "timing_suspect": bool(r.get("timing_suspect")),
+            }
+            for n, r in modes.items()
+        }
+    digests = {
+        "config1": ("rows_per_s", "host_suspect"),
+        "config3": ("rows_per_s", "distortion", "timing_suspect"),
+        "config4": ("rows_per_s", "raw_kernel_rows_per_s",
+                    "estimator_vs_raw", "timing_suspect"),
+        "config5": ("end_to_end_docs_per_s", "end_to_end_serial_docs_per_s",
+                    "ingest_tokens_per_s", "device_sketch_docs_per_s",
+                    "ingest_host_suspect", "sketch_timing_suspect",
+                    "pipeline_timing_suspect", "serial_timing_suspect"),
+    }
+    for name, keys in digests.items():
+        src = record.get(name)
+        if isinstance(src, dict):
+            c[name] = {k: _sig(src[k]) for k in keys if k in src}
+    tk = (record.get("config4") or {}).get("topk_serving")
+    if isinstance(tk, dict) and "queries_per_s" in tk:
+        c4d = c.setdefault("config4", {})
+        c4d["topk_queries_per_s"] = _sig(tk["queries_per_s"])
+        if "timing_suspect" in tk:
+            # the serving bench self-flags independently of the main
+            # config4 kernel — the flattened digest must keep ITS flag or
+            # a suspect serving rate becomes a trusted baseline
+            c4d["topk_timing_suspect"] = bool(tk["timing_suspect"])
+    regs = record.get("regressions", [])
+    if len(regs) > 8:
+        c["regressions_truncated"] = len(regs) - 8
+        regs = regs[:8]
+    c["regressions"] = regs
+    if record.get("regressions_vs") is not None:
+        c["regressions_vs"] = record["regressions_vs"]
+    if record.get("regressions_skipped"):
+        c["regressions_skipped"] = record["regressions_skipped"]
+
+    def size(d):
+        return len(json.dumps(d, separators=(",", ":")).encode())
+
+    for victim in ("all_modes", "config5", "config4"):
+        if size(c) <= COMPACT_MAX_BYTES:
+            break
+        if victim in c:  # pragma: no cover — needs a pathological record
+            del c[victim]
+            c.setdefault("compact_dropped", []).append(victim)
+    return c
+
+
+def emit_bench_output(record: dict) -> None:
+    """Print the full record, then the compact digest as the FINAL stdout
+    line — the driver's tail capture can truncate the former but, at ≤2 KB,
+    never loses the latter."""
+    print(json.dumps(record))
+    print(json.dumps(compact_summary(record), separators=(",", ":")))
+
+
 def run(preset: str = "full", k: int = 256, d: int = 4096,
         density: float = 1.0 / 3.0) -> dict:
     import jax
@@ -813,7 +1193,7 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
         if abs(density - 1.0 / 3.0) < 1e-12
         else f"sparse density={density:.4g}"
     )
-    return {
+    record = {
         "metric": f"rows/sec/chip {d}->{k} ({workload}, data-resident, {headline})",
         "value": round(head["rows_per_s"], 1),
         "unit": "rows/s",
@@ -854,8 +1234,16 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
             if preset == "full"
             else measure_config5(n_docs=8192)
         ),
+        "preset": preset,
+        "shape_is_default": bool(
+            k == 256 and d == 4096 and abs(density - 1.0 / 3.0) < 1e-12
+        ),
     }
+    # the round-over-round tripwire: any non-suspect rate >10% under the
+    # newest committed record is listed under "regressions" — config-3's
+    # silent r5 decay becomes a recorded event (ISSUE r7)
+    return attach_regressions(record)
 
 
 def main(preset: str = "full") -> None:
-    print(json.dumps(run(preset)))
+    emit_bench_output(run(preset))

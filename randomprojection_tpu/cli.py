@@ -40,8 +40,7 @@ def _add_common(p):
     p.add_argument("--materialization", default=None,
                    choices=["dense", "lazy"],
                    help="jax backend: 'lazy' = in-kernel mask (TPU only)")
-    p.add_argument("--log-level", default="warning",
-                   choices=["debug", "info", "warning", "error"])
+    _add_observability(p)
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
     p.add_argument("--debug-nans", action="store_true",
@@ -50,6 +49,19 @@ def _add_common(p):
     p.add_argument("--disable-jit", action="store_true",
                    help="jax.config jax_disable_jit: run op-by-op for "
                         "debugging (orders slower)")
+
+
+def _add_observability(p):
+    """Flags shared by every workload subcommand (``project``,
+    ``stream-bench`` via ``_add_common``, and ``bench``): logging level
+    and the process-wide structured event log."""
+    p.add_argument("--log-level", default="warning",
+                   choices=["debug", "info", "warning", "error"])
+    p.add_argument("--telemetry-jsonl", default=None, metavar="PATH",
+                   help="append structured telemetry events (versioned "
+                        "JSONL schema — see utils/telemetry.py) for every "
+                        "pipeline stage, dispatch, commit and degraded "
+                        "retry to this file")
 
 
 def _positive_int(v: str) -> int:
@@ -113,6 +125,7 @@ def build_parser():
                    help="output dimension for the headline modes")
     q.add_argument("--density", type=_density_arg, default=1.0 / 3.0,
                    help="mask density for the headline modes")
+    _add_observability(q)
 
     q = sub.add_parser("stream-bench", help="host-streamed throughput")
     q.add_argument("--rows", type=int, default=262144)
@@ -319,10 +332,12 @@ def cmd_project(args):
 
 
 def cmd_bench(args):
-    from randomprojection_tpu.benchmark import run
+    from randomprojection_tpu.benchmark import emit_bench_output, run
 
-    print(json.dumps(run(args.preset, k=args.k, d=args.d,
-                         density=args.density)))
+    # full record first, then the ≤2 KB compact digest as the FINAL line —
+    # same tail-safe contract as the repo-root bench.py entry point
+    emit_bench_output(run(args.preset, k=args.k, d=args.d,
+                          density=args.density))
 
 
 def cmd_stream_bench(args):
@@ -425,6 +440,15 @@ def main(argv=None):
         import os
 
         os.environ["RP_HASH_THREADS"] = str(args.hash_threads)
+    if getattr(args, "telemetry_jsonl", None):
+        # process-wide sink: every instrumented call site (streaming
+        # stages, backend dispatches, degraded retries, hash batches,
+        # simhash serving) starts appending versioned JSONL events.
+        # AFTER flag validation: an invalid invocation must abort without
+        # touching (creating or tail-repairing) the event file
+        from randomprojection_tpu.utils import telemetry
+
+        telemetry.configure(args.telemetry_jsonl)
     # debug switches (SURVEY.md §6): applied before any jax computation
     if getattr(args, "debug_nans", False):
         import jax

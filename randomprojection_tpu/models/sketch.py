@@ -29,6 +29,7 @@ from randomprojection_tpu.models.base import (
     ParamsMixin,
     _resolve_seed,
 )
+from randomprojection_tpu.utils import telemetry
 from randomprojection_tpu.utils.validation import NotFittedError, check_array
 
 __all__ = [
@@ -372,6 +373,17 @@ class SimHashIndex:
                     :, : c.n
                 ]
                 col += c.n
+            # per-chunk dispatch count: many tiny add()s accumulate one
+            # device dispatch per chunk per tile — this is the counter
+            # that makes that cost visible round-over-round
+            telemetry.registry().counter_inc(
+                "simhash.chunk_dispatches", len(self._chunks)
+            )
+            if telemetry.enabled():
+                telemetry.emit(
+                    "simhash.query_tile", queries=int(hi - lo),
+                    chunks=len(self._chunks), n_codes=self.n_codes,
+                )
         return out
 
     def query_cosine(self, A, *, tile: int = 2048):
@@ -445,6 +457,11 @@ class SimHashIndex:
         ):
             # int32 key packing cannot represent the request on device:
             # serve it through the dense path rather than raising
+            telemetry.registry().counter_inc("simhash.topk_dense_fallbacks")
+            telemetry.emit(
+                "simhash.topk_dense_fallback", m=int(m_eff),
+                n_codes=self.n_codes, n_bits=self.n_bytes * 8,
+            )
             out_d = np.empty((A.shape[0], m_eff), dtype=np.int32)
             out_i = np.empty((A.shape[0], m_eff), dtype=np.int32)
             for lo in range(0, A.shape[0], tile):
@@ -470,6 +487,14 @@ class SimHashIndex:
                 cand_d.append(np.asarray(d))
                 cand_i.append(np.asarray(i).astype(np.int64) + base)
                 base += c.n
+            telemetry.registry().counter_inc(
+                "simhash.chunk_dispatches", len(self._chunks)
+            )
+            if telemetry.enabled():
+                telemetry.emit(
+                    "simhash.topk_tile", queries=int(hi - lo), m=int(m_eff),
+                    chunks=len(self._chunks), n_codes=self.n_codes,
+                )
             d = np.concatenate(cand_d, axis=1)
             i = np.concatenate(cand_i, axis=1)
             # clamp sentinel ids (empty per-shard slots carry id 2^31-1)
@@ -529,9 +554,21 @@ class SimHashIndex:
         # from the packed key.  dist ≤ n_bits (sentinel n_bits+1), so the
         # key fits int32 for any practical (bits, block) pair.
         sentinel = n_bits_total + 1
+        blk_requested = blk
         blk = _topk_block_clamp(blk, m_c, sentinel)
+        if blk != blk_requested:
+            # wide codes / big m shrank the scan block to keep the packed
+            # int32 key representable: same results, more scan steps —
+            # recorded so a throughput drop has its cause on file
+            telemetry.registry().counter_inc("simhash.topk_block_clamps")
+            telemetry.emit(
+                "simhash.topk_block_clamp", requested=int(blk_requested),
+                clamped=int(blk), m=int(m_c), n_bits=n_bits_total,
+            )
         width = m_c + blk  # packing base W
-        if sentinel * width + width >= 2**31:  # pragma: no cover
+        # same predicate as the dense-fallback gate (idempotent under the
+        # clamp), so the two sites cannot drift
+        if not _topk_key_fits_int32(n_bits_total, m_c, blk):  # pragma: no cover
             raise ValueError(
                 f"top-k key would overflow int32: bits={n_bits_total}, "
                 f"block={blk}"

@@ -26,6 +26,7 @@ import numpy as np
 import scipy.sparse as sp
 
 from randomprojection_tpu.native.build import load_murmur3
+from randomprojection_tpu.utils import telemetry
 
 __all__ = [
     "murmur3_32", "hash_tokens", "FeatureHasher", "hash_threads_override",
@@ -57,6 +58,30 @@ def _requested_threads(n_threads: Optional[int]) -> int:
     if n_threads is not None:
         return int(n_threads)
     return int(getattr(_THREAD_OVERRIDE, "n", None) or 0)
+
+
+def _emit_hash_batch(path: str, n_tokens: int,
+                     n_threads: Optional[int]) -> None:
+    """One telemetry event per batch-hash call: which kernel path served
+    it (``strided`` / ``list`` / ``python``) and the worker count it
+    resolved to (0 = the kernel's hardware-concurrency default).  The
+    python path is the no-compiler fallback — a stream quietly riding it
+    is the silent 10× ingest regression this event exists to expose."""
+    telemetry.registry().counter_inc(f"hash.batches.{path}")
+    if telemetry.enabled():
+        threads = _requested_threads(n_threads)
+        if not threads:
+            # no explicit request or thread-local scope: the kernel (and,
+            # on legacy .so builds, hash_threads_override itself) resolves
+            # via RP_HASH_THREADS — report what will actually apply
+            try:
+                threads = int(os.environ.get("RP_HASH_THREADS", "0") or 0)
+            except ValueError:
+                threads = 0
+        telemetry.emit(
+            "hash.batch", path=path, tokens=int(n_tokens),
+            threads=threads, native=load_murmur3() is not None,
+        )
 
 
 @contextlib.contextmanager
@@ -196,6 +221,7 @@ def _hash_token_array(arr: np.ndarray, n_features: int, seed: int,
         codes = np.ascontiguousarray(arr).view(np.uint32).reshape(n, w)
         embedded, ulens = _nul_scan(codes)
         if embedded:
+            telemetry.registry().counter_inc("hash.embedded_nul_fallbacks")
             return hash_tokens(arr.tolist(), n_features, seed,
                                n_threads=n_threads)
         if lib is not None and int(codes.max(initial=0)) < 128:
@@ -210,9 +236,11 @@ def _hash_token_array(arr: np.ndarray, n_features: int, seed: int,
         sbuf = arr.view(np.uint8).reshape(n, arr.dtype.itemsize)
         embedded, lengths = _nul_scan(sbuf)
         if embedded:
+            telemetry.registry().counter_inc("hash.embedded_nul_fallbacks")
             return hash_tokens(arr.tolist(), n_features, seed,
                                n_threads=n_threads)
         if lib is None:  # no compiler: per-token fallback
+            _emit_hash_batch("python", n, n_threads)
             for i, tok in enumerate(arr.tolist()):
                 h = murmur3_32(tok, seed)
                 idx[i] = abs(h) % n_features
@@ -230,6 +258,7 @@ def _hash_token_array(arr: np.ndarray, n_features: int, seed: int,
         idx.ctypes.data_as(ctypes.c_void_p),
         sign.ctypes.data_as(ctypes.c_void_p),
     )
+    _emit_hash_batch("strided", n, n_threads)
     if getattr(lib, "has_explicit_threads", False):
         lib.hash_tokens_strided_t(*args, _requested_threads(n_threads))
     else:
@@ -275,6 +304,7 @@ def hash_tokens(tokens: Iterable, n_features: int, seed: int = 0,
         return idx, sign
 
     lib = load_murmur3()
+    _emit_hash_batch("list" if lib is not None else "python", n, n_threads)
     if lib is not None:
         buf = b"".join(encoded)
         offsets = np.zeros(n + 1, dtype=np.int64)

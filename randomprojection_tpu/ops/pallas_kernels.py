@@ -313,6 +313,7 @@ def fused_sparse_project(
                 "without the in-VMEM mask cache (regenerate-every-step "
                 "degradation)", key,
             )
+            record_vmem_oom_retry(x.shape, mxu_mode, n_components)
             out = _fused_impl(
                 x, seed, n_components, density, block_n=block_n,
                 block_offset=block_offset, mxu_mode=mxu_mode,
@@ -354,6 +355,20 @@ def is_vmem_oom(exc: Exception) -> bool:
     the regenerate-every-step path for the process lifetime."""
     s = str(exc).lower()
     return "vmem" in s and any(m in s for m in _VMEM_OOM_MARKERS)
+
+
+def record_vmem_oom_retry(shape, mxu_mode: str, n_components: int) -> None:
+    """Degraded-retry telemetry, shared by both call sites (the eager
+    fallback above and ``jax_backend._project_prepared``'s mesh retry) —
+    one counter name and one event schema, so the retry count can never
+    split between the two paths."""
+    from randomprojection_tpu.utils import telemetry
+
+    telemetry.registry().counter_inc("backend.vmem_oom_retries")
+    telemetry.emit(
+        "backend.vmem_oom_retry", shape=list(shape),
+        mxu_mode=mxu_mode, n_components=n_components,
+    )
 
 
 @functools.partial(

@@ -44,6 +44,7 @@ from typing import Callable, Iterator, Optional, Tuple
 import numpy as np
 import scipy.sparse as sp
 
+from randomprojection_tpu.utils import telemetry
 from randomprojection_tpu.utils.observability import (
     annotate,
     batch_nbytes,
@@ -326,16 +327,22 @@ class PrefetchSource(RowBatchSource):
                     if self.prepare is not None:
                         with _stage(self.stats, "h2d"):
                             batch = self.prepare(batch)
+                    depth_now = q.qsize()
                     if self.stats is not None:
                         # occupancy the producer found at delivery: 0 =
                         # the consumer had drained the queue (producer-
                         # bound), depth = full, the producer must wait
                         # (consumer-bound)
-                        self.stats.on_queue_depth(q.qsize())
+                        self.stats.on_queue_depth(depth_now)
+                    telemetry.emit(
+                        "stream.prefetch.deliver", row=int(lo),
+                        queue_depth=int(depth_now), capacity=self.depth,
+                    )
                     if not _put((lo, batch)):
                         return  # consumer went away
                 _put(self._DONE)
             except BaseException as e:  # propagate to the consumer thread
+                telemetry.emit("stream.prefetch.error", error=repr(e))
                 _put((self._DONE, e))
 
         worker = threading.Thread(
@@ -364,7 +371,22 @@ class PrefetchSource(RowBatchSource):
                 yield item
         finally:
             stop.set()
-            worker.join()
+            # bounded join: a worker stuck inside the inner source's read
+            # (stalled socket/pipe) or a hung prepare() never reaches the
+            # stop-aware _put, and an unbounded join would hang the
+            # CONSUMER on abandon.  The thread is a daemon, so timing out
+            # leaks nothing past interpreter exit — but it is an anomaly
+            # worth recording loudly.
+            worker.join(timeout=5.0)
+            if worker.is_alive():  # pragma: no cover — needs a hung read
+                from randomprojection_tpu.utils.observability import logger
+
+                logger.warning(
+                    "prefetch worker did not stop within 5s of shutdown "
+                    "(inner source read or prepare() appears hung); "
+                    "abandoning the daemon thread"
+                )
+                telemetry.emit("stream.prefetch.shutdown_timeout")
 
 
 @dataclasses.dataclass
@@ -465,6 +487,10 @@ def stream_transform(
             # transform, returning a lazy device handle where supported
             with annotate("rp:stream/dispatch"), _stage(stats, "dispatch"):
                 y = estimator._transform_async(batch)
+            telemetry.emit(
+                "stream.dispatch", row=int(start_row),
+                rows=int(getattr(batch, "shape", (0,))[0]),
+            )
             fetch_async = getattr(y, "copy_to_host_async", None)
             if fetch_async is not None:
                 # start the d2h as soon as the device finishes this batch:

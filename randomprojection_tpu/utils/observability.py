@@ -4,15 +4,23 @@ The reference's only instrumentation is Python warnings (and Spark's web UI
 on the spark backend); here streams carry structured counters and any
 transform region can be wrapped in a ``jax.profiler`` trace for
 TensorBoard/Perfetto.
+
+Since r7 the counters are backed by ``utils.telemetry.MetricsRegistry``
+(counters / gauges / log2 wall-clock histograms) and every instrumented
+region double-writes to the process-wide JSONL event log when one is
+configured (``telemetry.configure`` / CLI ``--telemetry-jsonl``) — the
+``StreamStats`` surface and ``summary()`` output are unchanged.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
-import threading
 import time
 from typing import Optional
+
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import MetricsRegistry
 
 logger = logging.getLogger("randomprojection_tpu")
 
@@ -73,13 +81,23 @@ class StreamStats:
     (host materialization), so throughput includes the full h2d → einsum →
     d2h pipeline, not just dispatch.
 
+    Storage is a ``telemetry.MetricsRegistry`` (one per StreamStats, or a
+    shared one passed as ``registry=``): commit counters are registry
+    counters, stage walls are log2 wall-clock histograms (their exact
+    ``sum`` is the ``stage_wall`` value — histograms carry the totals,
+    buckets are for distribution shape), the queue-occupancy samples are a
+    gauge.  The legacy attribute surface (``batches``/``rows``/
+    ``bytes_in``/``bytes_out``/``stage_wall``/``queue_depth_max``) is
+    preserved as read-only views of the registry, and ``summary()`` emits
+    the same keys as before the re-base.
+
     Per-stage wall attribution: pipeline stages (``hash`` in ``TokenSource``,
     ``h2d`` in ``PrefetchSource``'s prepare step, ``dispatch``/``d2h`` in
     ``stream_transform``) wrap themselves in ``stage(name)``, accumulating
-    wall-clock into ``stage_wall`` under a lock — the producer stages run on
-    the prefetch worker thread, the consumer stages on the caller's, so with
-    an overlapped pipeline the stage walls can legitimately sum to MORE than
-    the end-to-end elapsed time.  That excess is the measured overlap:
+    wall-clock — the producer stages run on the prefetch worker thread, the
+    consumer stages on the caller's, so with an overlapped pipeline the
+    stage walls can legitimately sum to MORE than the end-to-end elapsed
+    time.  That excess is the measured overlap:
     ``overlap_ratio() = 1 - elapsed / Σ stage_wall`` (clamped at 0) — 0 for
     a fully serial pipeline, → 0.5 when two equal stages fully overlap.
     ``on_queue_depth`` is the prefetch queue-occupancy gauge, sampled by
@@ -89,19 +107,43 @@ class StreamStats:
     (consumer-bound).
     """
 
-    def __init__(self, log_every: int = 0):
+    def __init__(self, log_every: int = 0,
+                 registry: Optional[MetricsRegistry] = None):
         self.log_every = log_every
-        self.batches = 0
-        self.rows = 0
-        self.bytes_in = 0
-        self.bytes_out = 0
-        self.stage_wall: dict = {}
-        self.queue_depth_max = 0
-        self._queue_depth_sum = 0
-        self._queue_depth_n = 0
-        self._lock = threading.Lock()
+        self.registry = registry if registry is not None else MetricsRegistry()
         self._t0: Optional[float] = None
         self._t_last: Optional[float] = None
+
+    # -- registry-backed views (the pre-r7 attribute surface) ---------------
+
+    @property
+    def batches(self) -> int:
+        return int(self.registry.counter("stream.batches"))
+
+    @property
+    def rows(self) -> int:
+        return int(self.registry.counter("stream.rows"))
+
+    @property
+    def bytes_in(self) -> int:
+        return int(self.registry.counter("stream.bytes_in"))
+
+    @property
+    def bytes_out(self) -> int:
+        return int(self.registry.counter("stream.bytes_out"))
+
+    @property
+    def stage_wall(self) -> dict:
+        return self.registry.hist_sums("stage.")
+
+    @property
+    def queue_depth_max(self) -> int:
+        return int(self.registry.gauge_max("stream.queue_depth"))
+
+    def queue_depth_mean(self) -> float:
+        return self.registry.gauge_mean("stream.queue_depth")
+
+    # -- recording ----------------------------------------------------------
 
     def start(self) -> None:
         """Start the clock — called by ``stream_transform`` before the first
@@ -114,11 +156,17 @@ class StreamStats:
         if self._t0 is None:  # standalone use without start(): degrade
             self._t0 = now
         self._t_last = now
-        self.batches += 1
         n = getattr(batch_out, "shape", (0,))[0]
-        self.rows += n
-        self.bytes_in += bytes_in
-        self.bytes_out += batch_nbytes(batch_out)
+        out_bytes = batch_nbytes(batch_out)
+        r = self.registry
+        r.counter_inc("stream.batches")
+        r.counter_inc("stream.rows", n)
+        r.counter_inc("stream.bytes_in", bytes_in)
+        r.counter_inc("stream.bytes_out", out_bytes)
+        telemetry.emit(
+            "stream.commit", row=int(start_row), rows=int(n),
+            bytes_in=int(bytes_in), bytes_out=int(out_bytes),
+        )
         if self.log_every and self.batches % self.log_every == 0:
             logger.info(
                 "stream: %d batches, %d rows, %.0f rows/s",
@@ -135,22 +183,13 @@ class StreamStats:
             yield
         finally:
             dt = time.perf_counter() - t0
-            with self._lock:
-                self.stage_wall[name] = self.stage_wall.get(name, 0.0) + dt
+            self.registry.observe("stage." + name, dt)
+            telemetry.emit("stage.wall", stage=name, wall_s=round(dt, 6))
 
     def on_queue_depth(self, depth: int) -> None:
         """Record one prefetch-queue occupancy sample (taken by the
         producer at each delivery)."""
-        with self._lock:
-            if depth > self.queue_depth_max:
-                self.queue_depth_max = depth
-            self._queue_depth_sum += depth
-            self._queue_depth_n += 1
-
-    def queue_depth_mean(self) -> float:
-        if not self._queue_depth_n:
-            return 0.0
-        return self._queue_depth_sum / self._queue_depth_n
+        self.registry.gauge_set("stream.queue_depth", depth)
 
     def overlap_ratio(self) -> float:
         """Fraction of attributed stage wall hidden by overlap:
@@ -182,12 +221,13 @@ class StreamStats:
             "elapsed_s": round(self.elapsed_s(), 4),
             "rows_per_s": round(self.rows_per_s(), 1),
         }
-        if self.stage_wall:
+        stage_wall = self.stage_wall
+        if stage_wall:
             out["stage_wall_s"] = {
-                k: round(v, 4) for k, v in sorted(self.stage_wall.items())
+                k: round(v, 4) for k, v in sorted(stage_wall.items())
             }
             out["pipeline_overlap_ratio"] = round(self.overlap_ratio(), 3)
-        if self._queue_depth_n:
+        if self.registry.gauge("stream.queue_depth")["n"]:
             out["queue_depth_max"] = self.queue_depth_max
             out["queue_depth_mean"] = round(self.queue_depth_mean(), 2)
         return out

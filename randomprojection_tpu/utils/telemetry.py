@@ -1,0 +1,379 @@
+"""Telemetry spine: metrics registry + structured JSONL event log.
+
+Round 5's verdict showed the repo losing its own evidence: the flagship
+bench headline never survived the driver's tail capture, a 13% config
+regression went unflagged, and prose quoted numbers the committed record
+contradicted.  This module is the durable half of the fix (the bench's
+tail-safe compact line and regression tripwire are the other half —
+``benchmark.py``):
+
+- **MetricsRegistry** — process-wide counters, gauges and wall-clock
+  histograms (fixed log2 buckets), thread-safe, snapshot-able to plain
+  JSON.  ``StreamStats`` (``utils/observability.py``) is re-based on a
+  registry, so every streamed run's counters are one ``snapshot()`` away
+  from a machine-readable record.
+- **TelemetryLog** — a JSONL event sink with a versioned schema: one
+  event per pipeline stage / dispatch / commit / degraded retry,
+  appended as a single line so a crash can lose at most the final event.
+  ``parse_event``/``read_events`` are the shipped round-trip parsers —
+  anything the sink writes, they load back.
+
+Instrumented call sites go through the module-level ``emit()`` which is
+a no-op (one attribute read) until ``configure()`` installs a sink —
+the hot paths pay nothing when telemetry is off.  The CLI flag
+``--telemetry-jsonl PATH`` (``project``/``stream-bench``/``bench``)
+installs the process-wide sink.
+
+Event schema (version 1) — every line is a JSON object with:
+
+- ``v``     int, schema version (1)
+- ``ts``    float, unix seconds (``time.time()``)
+- ``event`` str, dotted event name (``stream.commit``,
+  ``backend.dispatch``, ``backend.vmem_oom_retry``, ``stage.wall``,
+  ``hash.batch``, ``simhash.query_tile``, ``simhash.topk_block_clamp``,
+  ``simhash.topk_dense_fallback``, ``stream.prefetch.deliver``, ...)
+- any further keys are event-specific payload (JSON scalars /
+  lists / dicts only).
+
+The schema is append-only: new payload keys may appear, ``v`` bumps
+only if the meaning of an existing key changes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "MetricsRegistry",
+    "TelemetryLog",
+    "configure",
+    "shutdown",
+    "enabled",
+    "emit",
+    "parse_event",
+    "read_events",
+]
+
+SCHEMA_VERSION = 1
+
+
+class MetricsRegistry:
+    """Thread-safe counters, gauges and log2 wall-clock histograms.
+
+    - ``counter_inc(name, value)`` — monotone accumulators (batches,
+      rows, bytes, dispatches, retries).
+    - ``gauge_set(name, value)`` — point-in-time samples; the registry
+      keeps ``last``/``max``/``sum``/``n`` so both extremes and means
+      are recoverable (the prefetch queue-occupancy gauge needs max AND
+      mean).
+    - ``observe(name, seconds)`` / ``timer(name)`` — wall-clock
+      histograms over fixed log2 buckets: bucket ``i`` holds samples in
+      ``[2^i, 2^(i+1))`` microseconds, so buckets are comparable across
+      processes and rounds (no adaptive boundaries to drift).  ``sum``
+      and ``count`` ride along, so totals (the ``StreamStats``
+      stage-wall contract) are exact, not bucket-approximated.
+
+    One registry per concern: ``StreamStats`` owns one per stream; the
+    process-wide default (``registry()``) collects cross-cutting counts
+    (backend dispatches, hash fallbacks, top-k clamps).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._hists: dict = {}
+
+    # -- counters -----------------------------------------------------------
+
+    def counter_inc(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def counter(self, name: str):
+        """Current value (0 when never incremented)."""
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    # -- gauges -------------------------------------------------------------
+
+    def gauge_set(self, name: str, value: float) -> None:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = {"last": value, "max": value, "sum": 0.0, "n": 0}
+                self._gauges[name] = g
+            g["last"] = value
+            if value > g["max"]:
+                g["max"] = value
+            g["sum"] += value
+            g["n"] += 1
+
+    def gauge(self, name: str) -> dict:
+        """``{last, max, sum, n}`` (zeros when never set)."""
+        with self._lock:
+            g = self._gauges.get(name)
+            return dict(g) if g else {"last": 0, "max": 0, "sum": 0.0, "n": 0}
+
+    def gauge_max(self, name: str):
+        return self.gauge(name)["max"]
+
+    def gauge_mean(self, name: str) -> float:
+        g = self.gauge(name)
+        return g["sum"] / g["n"] if g["n"] else 0.0
+
+    # -- histograms ---------------------------------------------------------
+
+    @staticmethod
+    def _bucket(seconds: float) -> int:
+        """Fixed log2 bucket index: ``floor(log2(max(seconds, 1e-6) / 1e-6))``
+        — bucket 0 is [1µs, 2µs), bucket 20 is [~1s, ~2s)."""
+        us = max(seconds, 1e-6) / 1e-6
+        return max(int(math.floor(math.log2(us))), 0)
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = {"sum": 0.0, "count": 0, "buckets": {}}
+                self._hists[name] = h
+            h["sum"] += seconds
+            h["count"] += 1
+            b = self._bucket(seconds)
+            h["buckets"][b] = h["buckets"].get(b, 0) + 1
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def hist_sum(self, name: str) -> float:
+        with self._lock:
+            h = self._hists.get(name)
+            return h["sum"] if h else 0.0
+
+    def hist_sums(self, prefix: str = "") -> dict:
+        """``{name_without_prefix: total_seconds}`` for every histogram
+        whose name starts with ``prefix`` (the ``StreamStats.stage_wall``
+        view is ``hist_sums('stage.')``)."""
+        with self._lock:
+            return {
+                k[len(prefix):]: h["sum"]
+                for k, h in self._hists.items()
+                if k.startswith(prefix)
+            }
+
+    # -- snapshot -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Plain-JSON view of every metric (bucket keys stringified so the
+        result survives ``json.dumps`` → ``json.loads`` unchanged)."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": {k: dict(v) for k, v in self._gauges.items()},
+                "histograms": {
+                    k: {
+                        "sum": h["sum"],
+                        "count": h["count"],
+                        "buckets": {
+                            str(b): c for b, c in sorted(h["buckets"].items())
+                        },
+                    }
+                    for k, h in self._hists.items()
+                },
+            }
+
+
+_DEFAULT_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default registry (cross-cutting counters: backend
+    dispatches, VMEM-OOM retries, hash fallbacks, top-k clamps)."""
+    return _DEFAULT_REGISTRY
+
+
+def _repair_torn_tail(path: str) -> None:
+    """Make an existing event file append-safe before reopening it.
+
+    A previous run that crashed mid-write leaves a torn final line with
+    no trailing newline; appending onto it would merge it with the new
+    run's first event into one corrupt MID-file line, which the strict
+    reader rightly refuses — turning a lost-final-event file into an
+    unreadable one.  A fragment that parses as a complete event (only
+    the newline was lost) is terminated; a genuinely torn fragment is
+    truncated away — that event was already lost at crash time — but
+    ONLY when the preceding complete line proves the file is already a
+    telemetry log: a user pointing ``--telemetry-jsonl`` at some other
+    newline-less file must never have its content destroyed (the repair
+    then just terminates the line and appends after it).
+    """
+    try:
+        f = open(path, "r+b")
+    except FileNotFoundError:
+        return
+    with f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            return
+        f.seek(size - 1)
+        if f.read(1) == b"\n":
+            return
+        window = min(size, 1 << 20)  # events are far smaller than 1 MB
+        f.seek(size - window)
+        tail = f.read(window)
+        nl = tail.rfind(b"\n")
+        if nl < 0 and size > window:  # pragma: no cover — >1 MB one-line
+            f.write(b"\n")  # can't see the line start; don't destroy data
+            return
+        frag = tail[nl + 1:]
+
+        def _parses(raw: bytes) -> bool:
+            try:
+                parse_event(raw.decode("utf-8"))
+                return True
+            except (ValueError, UnicodeDecodeError):
+                return False
+
+        if _parses(frag):
+            f.write(b"\n")  # complete event, only the newline was lost
+            return
+        prev_is_event = nl >= 0 and _parses(
+            tail[tail.rfind(b"\n", 0, nl) + 1 : nl]
+        )
+        # a run that crashed writing its very FIRST event leaves no
+        # preceding line to prove ownership; the sink's own serialization
+        # prefix is the next-best evidence (either direction of
+        # startswith: the fragment may be shorter than the prefix)
+        own_prefix = b'{"v":'
+        frag_is_ours = frag.startswith(own_prefix) or own_prefix.startswith(
+            frag
+        )
+        if prev_is_event or (nl < 0 and frag_is_ours):
+            f.truncate(size - len(frag))  # our log's torn final event
+        else:
+            f.write(b"\n")  # not provably our log: preserve the content
+
+
+class TelemetryLog:
+    """Append-only JSONL event sink (versioned schema, thread-safe).
+
+    Each ``emit`` writes exactly one ``\\n``-terminated line and flushes,
+    so concurrent producer/consumer threads interleave whole events and
+    a crash loses at most the event being written.  Reopening a file a
+    crashed run left torn repairs the tail first (``_repair_torn_tail``),
+    so multi-run files stay readable end to end.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        _repair_torn_tail(path)
+        self._f = open(path, "a")
+
+    def emit(self, event: str, **fields) -> None:
+        rec = {"v": SCHEMA_VERSION, "ts": time.time(), "event": event}
+        rec.update(fields)
+        line = json.dumps(rec, separators=(",", ":"))
+        with self._lock:
+            if self._f is None:  # pragma: no cover - emit after close
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+_ACTIVE_LOG: Optional[TelemetryLog] = None
+
+
+def configure(path: str) -> TelemetryLog:
+    """Install the process-wide JSONL sink (replacing any previous one).
+    Instrumented call sites all over the package start emitting into it
+    immediately; ``shutdown()`` uninstalls and closes."""
+    global _ACTIVE_LOG
+    if _ACTIVE_LOG is not None:
+        _ACTIVE_LOG.close()
+    _ACTIVE_LOG = TelemetryLog(path)
+    return _ACTIVE_LOG
+
+
+def shutdown() -> None:
+    global _ACTIVE_LOG
+    if _ACTIVE_LOG is not None:
+        _ACTIVE_LOG.close()
+        _ACTIVE_LOG = None
+
+
+def enabled() -> bool:
+    """True when a process-wide sink is installed.  Hot paths with
+    non-trivial payload construction should guard on this."""
+    return _ACTIVE_LOG is not None
+
+
+def emit(event: str, **fields) -> None:
+    """Emit one event to the process-wide sink; no-op when none is
+    installed (one global read — safe in hot paths)."""
+    log = _ACTIVE_LOG
+    if log is not None:
+        log.emit(event, **fields)
+
+
+def parse_event(line: str) -> dict:
+    """Parse + validate one JSONL event line (the shipped round-trip
+    parser: anything ``TelemetryLog.emit`` writes, this loads back).
+    Raises ``ValueError`` on malformed lines or unsupported versions."""
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"not a JSON event line: {line!r}") from e
+    if not isinstance(rec, dict):
+        raise ValueError(f"event line is not an object: {line!r}")
+    if rec.get("v") != SCHEMA_VERSION:
+        raise ValueError(
+            f"unsupported telemetry schema version {rec.get('v')!r} "
+            f"(supported: {SCHEMA_VERSION})"
+        )
+    if not isinstance(rec.get("event"), str) or not isinstance(
+        rec.get("ts"), (int, float)
+    ):
+        raise ValueError(f"event line missing 'event'/'ts': {line!r}")
+    return rec
+
+
+def read_events(path: str) -> Iterator[dict]:
+    """Iterate the validated events of a JSONL telemetry file.  A torn
+    FINAL line (crash mid-write) is tolerated and skipped; a torn line
+    anywhere else raises — that file is corrupt, not merely truncated.
+    Streams with one line of lookahead (O(1) memory): a long run's
+    multi-GB event log never has to fit in host memory to be read."""
+    with open(path) as f:
+        pending: Optional[str] = None
+        for line in f:
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            if pending is not None:
+                yield parse_event(pending)  # non-final: torn ⇒ raise
+            pending = line
+        if pending is not None:
+            try:
+                yield parse_event(pending)
+            except ValueError:  # torn final line: tolerated
+                return

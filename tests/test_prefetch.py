@@ -384,7 +384,7 @@ def test_stream_stats_stage_attribution_and_queue_gauge():
     assert all(v >= 0 for v in stats.stage_wall.values())
     assert 0.0 <= stats.overlap_ratio() < 1.0
     # one producer-side occupancy sample per delivered batch
-    assert stats._queue_depth_n == 4
+    assert stats.registry.gauge("stream.queue_depth")["n"] == 4
     assert 0 <= stats.queue_depth_max <= 2
     s = stats.summary()
     assert "stage_wall_s" in s and "pipeline_overlap_ratio" in s

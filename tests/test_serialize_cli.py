@@ -541,7 +541,13 @@ def test_cli_bench_forwards_custom_shapes(monkeypatch, capsys):
     cli.main(["bench", "--preset", "smoke", "--d", "512", "--k", "32",
               "--density", "0.5"])
     assert captured == {"preset": "smoke", "k": 32, "d": 512, "density": 0.5}
-    assert json.loads(capsys.readouterr().out)["metric"] == "fake"
+    # tail-safe output contract: full record line first, compact digest
+    # as the FINAL line
+    lines = capsys.readouterr().out.splitlines()
+    assert json.loads(lines[0])["metric"] == "fake"
+    compact = json.loads(lines[-1])
+    assert compact[benchmark.COMPACT_MARKER] == benchmark.COMPACT_SCHEMA_VERSION
+    assert compact["metric"] == "fake"
 
 
 def test_cli_project_pipeline_depth(tmp_path):

@@ -1,0 +1,758 @@
+"""Telemetry spine tests (ISSUE r7): metrics registry, JSONL event log
+round-trip, tail-safe bench compact line, and the regression tripwire.
+
+Schema-validation contract (tier-1): the FINAL stdout line of a bench
+invocation is a self-contained ≤2 KB JSON summary carrying the headline
+mode record, per-config digests and a ``regressions`` key computed
+against the newest committed ``BENCH_r*.json`` — and every committed
+``BENCH_r*.json`` must keep parsing through the shipped loader.
+"""
+
+import glob
+import json
+import pathlib
+import threading
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from randomprojection_tpu import benchmark
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.observability import StreamStats, batch_nbytes
+from randomprojection_tpu.utils.telemetry import (
+    MetricsRegistry,
+    TelemetryLog,
+    parse_event,
+    read_events,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _no_global_sink():
+    """Tests that configure the process-wide sink must not leak it."""
+    yield
+    telemetry.shutdown()
+
+
+# -- MetricsRegistry ---------------------------------------------------------
+
+
+def test_registry_counters_and_gauges():
+    r = MetricsRegistry()
+    assert r.counter("x") == 0
+    r.counter_inc("x")
+    r.counter_inc("x", 4)
+    assert r.counter("x") == 5
+    r.gauge_set("q", 2)
+    r.gauge_set("q", 7)
+    r.gauge_set("q", 3)
+    assert r.gauge_max("q") == 7
+    assert r.gauge_mean("q") == pytest.approx(4.0)
+    assert r.gauge("q")["last"] == 3
+    # unset gauge reads as zeros, not KeyError
+    assert r.gauge_max("nope") == 0 and r.gauge_mean("nope") == 0.0
+
+
+def test_registry_log2_histogram_buckets():
+    """Fixed log2 buckets: bucket i holds [2^i, 2^(i+1)) microseconds,
+    sub-microsecond samples clamp into bucket 0, and the exact sum rides
+    along (the StreamStats stage-wall contract is the SUM, buckets are
+    only distribution shape)."""
+    r = MetricsRegistry()
+    r.observe("t", 1.5e-6)   # bucket 0: [1us, 2us)
+    r.observe("t", 3.0e-6)   # bucket 1: [2us, 4us)
+    r.observe("t", 0.4e-6)   # clamps to bucket 0
+    r.observe("t", 1.5)      # [~1s, ~2s) = bucket 20
+    snap = r.snapshot()["histograms"]["t"]
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(1.5 + 1.5e-6 + 3.0e-6 + 0.4e-6)
+    assert snap["buckets"]["0"] == 2
+    assert snap["buckets"]["1"] == 1
+    assert snap["buckets"]["20"] == 1
+    assert r.hist_sum("t") == pytest.approx(snap["sum"])
+
+
+def test_registry_snapshot_is_plain_json():
+    r = MetricsRegistry()
+    r.counter_inc("a", 2)
+    r.gauge_set("g", 1.5)
+    with r.timer("w"):
+        pass
+    snap = r.snapshot()
+    assert json.loads(json.dumps(snap)) == snap
+
+
+# -- JSONL event log ---------------------------------------------------------
+
+
+def test_event_log_round_trips_through_parser(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    log = TelemetryLog(p)
+    log.emit("unit.test", a=1, b="x", nested={"k": [1, 2]})
+    log.emit("unit.other")
+    log.close()
+    events = list(read_events(p))
+    assert [e["event"] for e in events] == ["unit.test", "unit.other"]
+    assert events[0]["v"] == telemetry.SCHEMA_VERSION
+    assert events[0]["a"] == 1 and events[0]["nested"] == {"k": [1, 2]}
+    assert all(isinstance(e["ts"], float) for e in events)
+
+
+def test_event_parser_rejects_garbage_and_wrong_version():
+    with pytest.raises(ValueError, match="JSON"):
+        parse_event("not json at all")
+    with pytest.raises(ValueError, match="version"):
+        parse_event(json.dumps({"v": 99, "ts": 0.0, "event": "x"}))
+    with pytest.raises(ValueError, match="event"):
+        parse_event(json.dumps({"v": 1, "ts": 0.0}))
+    with pytest.raises(ValueError, match="object"):
+        parse_event("[1, 2]")
+
+
+def test_read_events_tolerates_torn_final_line_only(tmp_path):
+    """A crash mid-write can tear at most the LAST line — tolerated; a
+    torn line mid-file means corruption and must raise."""
+    good = json.dumps({"v": 1, "ts": 0.0, "event": "a"})
+    p = tmp_path / "torn_tail.jsonl"
+    p.write_text(good + "\n" + good + "\n" + good[: len(good) // 2])
+    assert [e["event"] for e in read_events(str(p))] == ["a", "a"]
+    p2 = tmp_path / "torn_mid.jsonl"
+    p2.write_text(good[: len(good) // 2] + "\n" + good + "\n")
+    with pytest.raises(ValueError):
+        list(read_events(str(p2)))
+
+
+def test_reopened_sink_repairs_torn_tail(tmp_path):
+    """Appending a second run onto a file the first run left torn must
+    not merge the fragment with the new run's first event: the whole
+    multi-run file stays readable end to end."""
+    good = json.dumps({"v": 1, "ts": 0.0, "event": "run1"})
+    p = tmp_path / "multi.jsonl"
+    # crash left a genuinely torn fragment: it is dropped on reopen
+    p.write_text(good + "\n" + good[: len(good) // 2])
+    log = TelemetryLog(str(p))
+    log.emit("run2")
+    log.close()
+    assert [e["event"] for e in read_events(str(p))] == ["run1", "run2"]
+    # crash lost only the newline: the complete event is kept
+    p2 = tmp_path / "unterminated.jsonl"
+    p2.write_text(good + "\n" + good)  # no trailing \n
+    log = TelemetryLog(str(p2))
+    log.emit("run2")
+    log.close()
+    assert [e["event"] for e in read_events(str(p2))] == [
+        "run1", "run1", "run2"
+    ]
+
+
+def test_repair_never_truncates_foreign_files(tmp_path):
+    """--telemetry-jsonl pointed at an existing NON-telemetry file with no
+    trailing newline must not destroy its content — the repair only drops
+    a torn fragment when the file is provably our own log."""
+    p = tmp_path / "results.json"
+    p.write_text('{"my": "precious", "data": [1, 2, 3]}')  # no trailing \n
+    log = TelemetryLog(str(p))
+    log.emit("appended")
+    log.close()
+    content = p.read_text()
+    assert content.startswith('{"my": "precious"')  # preserved
+    assert '"event":"appended"' in content
+    # a lone torn FIRST event (sink's own prefix) is still cleaned up
+    p2 = tmp_path / "fresh.jsonl"
+    p2.write_text('{"v":1,"ts":123.0,"eve')  # torn mid-first-event
+    log = TelemetryLog(str(p2))
+    log.emit("only")
+    log.close()
+    assert [e["event"] for e in read_events(str(p2))] == ["only"]
+
+
+def test_emit_is_noop_without_sink(tmp_path):
+    telemetry.shutdown()
+    telemetry.emit("never.lands", x=1)  # must not raise
+    p = str(tmp_path / "s.jsonl")
+    telemetry.configure(p)
+    assert telemetry.enabled()
+    telemetry.emit("lands", x=1)
+    telemetry.shutdown()
+    assert not telemetry.enabled()
+    telemetry.emit("after.shutdown")  # dropped
+    assert [e["event"] for e in read_events(p)] == ["lands"]
+
+
+# -- instrumented pipeline end-to-end (the --telemetry-jsonl acceptance) -----
+
+
+def test_cli_project_telemetry_jsonl_round_trips(tmp_path):
+    """A CLI run with --telemetry-jsonl produces a JSONL event log whose
+    events round-trip through the shipped parser, and the stream's
+    stage/commit/dispatch events are all present."""
+    from randomprojection_tpu import cli
+
+    X = np.random.default_rng(0).normal(size=(300, 64)).astype(np.float32)
+    xin = str(tmp_path / "x.npy")
+    yout = str(tmp_path / "y.npy")
+    tel = str(tmp_path / "events.jsonl")
+    np.save(xin, X)
+    cli.main([
+        "project", "--input", xin, "--output", yout,
+        "--kind", "gaussian", "--n-components", "8",
+        "--backend", "numpy", "--batch-rows", "100",
+        "--telemetry-jsonl", tel, "--log-level", "warning",
+    ])
+    events = list(read_events(tel))
+    kinds = {e["event"] for e in events}
+    assert {"stream.dispatch", "stream.commit", "stage.wall"} <= kinds
+    commits = [e for e in events if e["event"] == "stream.commit"]
+    assert sum(e["rows"] for e in commits) == 300
+    assert all(e["v"] == telemetry.SCHEMA_VERSION for e in events)
+
+
+def test_prefetch_token_stream_emits_producer_events(tmp_path):
+    """The overlapped pipeline's producer side emits delivery + hash
+    events; the consumer side emits dispatch/commit — all into one file,
+    interleaved from two threads, every line parseable."""
+    from randomprojection_tpu.models.sketch import CountSketch
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+    from randomprojection_tpu.streaming import (
+        PrefetchSource,
+        TokenSource,
+        stream_transform,
+    )
+
+    tel = str(tmp_path / "ev.jsonl")
+    telemetry.configure(tel)
+    words = np.asarray([f"w{i}" for i in range(500)])
+
+    def read_tokens(lo, hi):
+        rng = np.random.default_rng(lo + 1)
+        toks = words[rng.integers(0, len(words), size=(hi - lo) * 8)]
+        return toks, np.arange(0, (hi - lo) * 8 + 1, 8)
+
+    fh = FeatureHasher(1 << 12, input_type="string", dtype=np.float32)
+    stats = StreamStats()
+    source = PrefetchSource(
+        TokenSource(read_tokens, 96, fh, batch_rows=32, stats=stats),
+        depth=2, stats=stats,
+    )
+    cs = CountSketch(16, random_state=0, backend="numpy").fit_source(source)
+    rows = sum(
+        y.shape[0] for _, y in stream_transform(cs, source, stats=stats)
+    )
+    telemetry.shutdown()
+    assert rows == 96
+    events = list(read_events(tel))
+    kinds = {e["event"] for e in events}
+    assert {"stream.prefetch.deliver", "hash.batch", "stage.wall",
+            "stream.dispatch", "stream.commit"} <= kinds
+    hash_events = [e for e in events if e["event"] == "hash.batch"]
+    assert all(e["path"] in ("strided", "list", "python")
+               for e in hash_events)
+    deliveries = [e for e in events if e["event"] == "stream.prefetch.deliver"]
+    assert len(deliveries) == 3  # one per produced batch
+    assert all(0 <= e["queue_depth"] <= 2 for e in deliveries)
+
+
+def test_vmem_oom_retry_recorder_shared(tmp_path):
+    """Both degraded-retry call sites (eager Pallas fallback, mesh path)
+    go through one recorder: one counter name, one event schema."""
+    from randomprojection_tpu.ops.pallas_kernels import record_vmem_oom_retry
+
+    tel = str(tmp_path / "oom.jsonl")
+    telemetry.configure(tel)
+    before = telemetry.registry().counter("backend.vmem_oom_retries")
+    record_vmem_oom_retry((128, 4096), "split2", 256)
+    telemetry.shutdown()
+    assert telemetry.registry().counter(
+        "backend.vmem_oom_retries"
+    ) == before + 1
+    (ev,) = read_events(tel)
+    assert ev["event"] == "backend.vmem_oom_retry"
+    assert ev["shape"] == [128, 4096] and ev["mxu_mode"] == "split2"
+
+
+def test_simhash_query_dispatch_counters():
+    jnp = pytest.importorskip("jax.numpy")  # noqa: F841
+    from randomprojection_tpu.models.sketch import SimHashIndex
+
+    rng = np.random.default_rng(0)
+    codes = rng.integers(0, 256, size=(100, 4), dtype=np.uint8)
+    idx = SimHashIndex(codes)
+    idx.add(rng.integers(0, 256, size=(50, 4), dtype=np.uint8))
+    before = telemetry.registry().counter("simhash.chunk_dispatches")
+    idx.query(codes[:8], tile=4)  # 2 tiles × 2 chunks
+    assert telemetry.registry().counter(
+        "simhash.chunk_dispatches"
+    ) == before + 4
+    before = telemetry.registry().counter("simhash.chunk_dispatches")
+    idx.query_topk(codes[:4], 3, tile=4)  # 1 tile × 2 chunks
+    assert telemetry.registry().counter(
+        "simhash.chunk_dispatches"
+    ) == before + 2
+
+
+# -- StreamStats edge cases (satellite) --------------------------------------
+
+
+def test_stream_stats_overlap_ratio_zero_elapsed():
+    s = StreamStats()
+    assert s.overlap_ratio() == 0.0  # nothing recorded at all
+    with s.stage("hash"):
+        pass
+    # stage wall exists but no commits → elapsed 0 → ratio clamps to 0
+    assert s.elapsed_s() == 0.0
+    assert s.overlap_ratio() == 0.0
+
+
+def test_stream_stats_on_commit_without_start():
+    s = StreamStats()
+    s.on_commit(0, 128, np.zeros((4, 8), dtype=np.float32))
+    assert s.batches == 1 and s.rows == 4 and s.bytes_in == 128
+    assert s.bytes_out == 4 * 8 * 4
+    # the degraded clock must yield a finite, sane rate — not inf/1e18
+    assert np.isfinite(s.rows_per_s()) and s.rows_per_s() < 1e10
+    assert "rows_per_s" in s.summary()
+
+
+def test_stream_stats_concurrent_stage_writers():
+    """Producer and consumer threads attribute stages concurrently; no
+    sample may be lost and per-stage totals must be non-negative."""
+    s = StreamStats()
+    n_iter = 400
+
+    def worker(name):
+        for _ in range(n_iter):
+            with s.stage(name):
+                pass
+
+    threads = [
+        threading.Thread(target=worker, args=(nm,))
+        for nm in ("producer", "consumer")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = s.registry.snapshot()["histograms"]
+    assert snap["stage.producer"]["count"] == n_iter
+    assert snap["stage.consumer"]["count"] == n_iter
+    assert set(s.stage_wall) == {"producer", "consumer"}
+    assert all(v >= 0.0 for v in s.stage_wall.values())
+
+
+def test_batch_nbytes_lil_dok_estimate_formula():
+    """LIL/DOK have no flat payload arrays: the estimate is the
+    COO-equivalent nnz·(itemsize + 2·intp) — never the 8-pointer-bytes-
+    per-row (LIL) or 0 (DOK) silent undercount."""
+    dense = np.zeros((32, 16), dtype=np.float32)
+    dense[::2, ::4] = 2.0
+    nnz = int((dense != 0).sum())
+    expect = nnz * (4 + 2 * np.dtype(np.intp).itemsize)
+    assert batch_nbytes(sp.lil_array(dense)) == expect
+    assert batch_nbytes(sp.dok_array(dense)) == expect
+    # and the estimate tracks the dtype's itemsize
+    assert batch_nbytes(sp.lil_array(dense.astype(np.float64))) == nnz * (
+        8 + 2 * np.dtype(np.intp).itemsize
+    )
+
+
+def test_stream_stats_summary_keys_unchanged():
+    """The registry re-base must not change the summary() surface."""
+    s = StreamStats()
+    s.start()
+    with s.stage("dispatch"):
+        pass
+    s.on_queue_depth(1)
+    s.on_commit(0, 64, np.zeros((2, 4), dtype=np.float32))
+    out = s.summary()
+    assert set(out) == {
+        "batches", "rows", "bytes_in", "bytes_out", "elapsed_s",
+        "rows_per_s", "stage_wall_s", "pipeline_overlap_ratio",
+        "queue_depth_max", "queue_depth_mean",
+    }
+
+
+# -- regression tripwire -----------------------------------------------------
+
+
+def _rec(**over):
+    rec = {
+        "value": 1000.0, "mode": "m", "timing_suspect": False,
+        "all_modes": {
+            "m": {"rows_per_s": 1000.0, "distortion": 1e-6,
+                  "timing_suspect": False},
+        },
+        "config1": {"rows_per_s": 500.0, "host_suspect": False},
+        "config5": {"end_to_end_docs_per_s": 100.0,
+                    "ingest_tokens_per_s": 1e6,
+                    "pipeline_timing_suspect": False,
+                    "ingest_host_suspect": False},
+    }
+    rec.update(over)
+    return rec
+
+
+def test_compute_regressions_flags_only_real_drops():
+    prev = _rec()
+    cur = _rec(
+        value=860.0,
+        all_modes={"m": {"rows_per_s": 860.0, "distortion": 1e-6,
+                         "timing_suspect": False}},
+        config1={"rows_per_s": 495.0, "host_suspect": False},  # -1%: fine
+    )
+    regs = benchmark.compute_regressions(cur, prev)
+    names = {r["metric"] for r in regs}
+    # the headline entry dedupes into the per-mode entry (same mode both
+    # rounds, identical numbers)
+    assert names == {"mode.m.rows_per_s"}
+    r = regs[0]
+    assert r["drop_pct"] == pytest.approx(14.0)
+    assert r["previous"] == 1000.0 and r["current"] == 860.0
+
+
+def test_compute_regressions_skips_suspect_rates_both_sides():
+    prev = _rec()
+    prev["config1"]["host_suspect"] = True  # previous side self-flagged
+    cur = _rec(
+        config1={"rows_per_s": 100.0, "host_suspect": False},  # -80% but…
+        config5={"end_to_end_docs_per_s": 10.0,  # -90% but current suspect
+                 "ingest_tokens_per_s": 1e6,
+                 "pipeline_timing_suspect": True,
+                 "ingest_host_suspect": False},
+    )
+    assert benchmark.compute_regressions(cur, prev) == []
+
+
+def test_serial_e2e_rate_gated_on_its_own_suspect_flag():
+    """A cache-served pipelined sample (pipeline_timing_suspect=True)
+    must not exclude the independently-measured SERIAL rate from the
+    tripwire — and a suspect serial sample must not become a baseline."""
+    c5 = {"end_to_end_serial_docs_per_s": 100.0,
+          "pipeline_timing_suspect": True,  # pipelined run disowned
+          "serial_timing_suspect": False}
+    assert benchmark.bench_rates({"config5": c5})[
+        "config5.end_to_end_serial_docs_per_s"
+    ] == (100.0, False)
+    c5["serial_timing_suspect"] = True
+    assert benchmark.bench_rates({"config5": c5})[
+        "config5.end_to_end_serial_docs_per_s"
+    ] == (100.0, True)
+
+
+def test_bench_rates_reads_flattened_compact_topk_rate():
+    """A previous round surviving only as its compact line flattens
+    topk_serving.queries_per_s to config4.topk_queries_per_s — the
+    tripwire must still compare the serving rate against it."""
+    prev = {"config4": {"rows_per_s": 5e7, "timing_suspect": False,
+                        "topk_queries_per_s": 1687.0}}
+    assert benchmark.bench_rates(prev)["config4.topk.queries_per_s"] == (
+        1687.0, False
+    )
+    cur = {"config4": {"rows_per_s": 5e7, "timing_suspect": False,
+                       "topk_serving": {"queries_per_s": 800.0,
+                                        "timing_suspect": False}}}
+    regs = benchmark.compute_regressions(cur, prev)
+    assert any(r["metric"] == "config4.topk.queries_per_s" for r in regs)
+    # the nested record wins when both shapes are present
+    both = {"config4": {"topk_queries_per_s": 1.0, "timing_suspect": False,
+                        "topk_serving": {"queries_per_s": 2.0,
+                                         "timing_suspect": False}}}
+    assert benchmark.bench_rates(both)["config4.topk.queries_per_s"] == (
+        2.0, False
+    )
+    # the serving bench's OWN suspect flag survives compaction and gates
+    # the fallback — a suspect serving rate never becomes a baseline
+    rec = {"config4": {"rows_per_s": 1.0, "timing_suspect": False,
+                       "topk_serving": {"queries_per_s": 9.9,
+                                        "timing_suspect": True}}}
+    c = benchmark.compact_summary(rec)
+    assert c["config4"]["topk_timing_suspect"] is True
+    assert benchmark.bench_rates(c)["config4.topk.queries_per_s"] == (
+        9.9, True
+    )
+
+
+def test_compute_regressions_exact_threshold_not_flagged():
+    prev = _rec()
+    cur = _rec(
+        value=900.0,
+        all_modes={"m": {"rows_per_s": 900.0, "distortion": 1e-6,
+                         "timing_suspect": False}},
+    )
+    # exactly 10% is the boundary, only STRICTLY beyond trips
+    assert benchmark.compute_regressions(cur, prev) == []
+
+
+def test_attach_regressions_gates_on_preset_and_shape():
+    rec = _rec(preset="smoke", shape_is_default=True)
+    out = benchmark.attach_regressions(rec)
+    assert out["regressions"] == [] and "regressions_skipped" in out
+    rec = _rec(preset="full", shape_is_default=False)
+    out = benchmark.attach_regressions(rec)
+    assert out["regressions"] == [] and "regressions_skipped" in out
+
+
+def test_compute_regressions_dedupes_headline_same_mode():
+    """Same mode headlining both rounds: its drop is listed once (the
+    per-mode entry), not twice with identical numbers."""
+    prev = _rec(mode="m")
+    cur = _rec(
+        mode="m", value=800.0,
+        all_modes={"m": {"rows_per_s": 800.0, "distortion": 1e-6,
+                         "timing_suspect": False}},
+    )
+    names = [r["metric"] for r in benchmark.compute_regressions(cur, prev)]
+    assert names == ["mode.m.rows_per_s"]
+    # a headline-mode CHANGE keeps the headline entry: the flagship rate
+    # moved for selection reasons worth flagging
+    cur2 = _rec(
+        mode="other", value=800.0,
+        all_modes={"other": {"rows_per_s": 800.0, "distortion": 1e-6,
+                             "timing_suspect": False}},
+    )
+    names2 = [r["metric"] for r in benchmark.compute_regressions(cur2, prev)]
+    assert "headline.rows_per_s" in names2
+
+
+def test_attach_regressions_falls_back_past_garbage_newest(tmp_path):
+    """A round whose bench crashed (unusable newest BENCH file) must not
+    turn the tripwire off — the next-newest intact record is used."""
+    good = {"config1": {"rows_per_s": 1000.0, "host_suspect": False}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(good))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "cmd": "", "rc": 1, "tail": "Traceback …", "parsed": None}
+    ))
+    rec = _rec(
+        preset="full", shape_is_default=True,
+        config1={"rows_per_s": 700.0, "host_suspect": False},
+    )
+    out = benchmark.attach_regressions(rec, root=str(tmp_path))
+    assert out["regressions_vs"] == "BENCH_r01.json"
+    assert any(
+        r["metric"] == "config1.rows_per_s" for r in out["regressions"]
+    )
+
+
+def test_attach_regressions_compares_against_committed_record():
+    """The real tripwire path: a full-preset record 20% under the newest
+    committed BENCH file must come back with that drop on file."""
+    newest = benchmark.newest_committed_bench(str(REPO))
+    assert newest is not None
+    prev = benchmark.load_bench_record(newest)
+    prev_rate = prev["config1"]["rows_per_s"]
+    rec = _rec(
+        preset="full", shape_is_default=True,
+        config1={"rows_per_s": prev_rate * 0.8, "host_suspect": False},
+    )
+    out = benchmark.attach_regressions(rec, root=str(REPO))
+    assert out["regressions_vs"] == pathlib.Path(newest).name
+    assert any(
+        r["metric"] == "config1.rows_per_s"
+        and r["drop_pct"] == pytest.approx(20.0, abs=0.2)
+        for r in out["regressions"]
+    )
+
+
+# -- committed BENCH records keep parsing ------------------------------------
+
+
+def test_tail_recovery_keeps_headline_suspect_flag():
+    """An all-suspect recovered run must not become a trusted baseline:
+    the re-derived headline inherits its mode's own suspect flag."""
+    tail = (
+        '"xmode": {"rows_per_s": 5e7, "distortion": 1e-06, '
+        '"timing_suspect": true}}'
+    )
+    rec = benchmark.recover_bench_tail(tail)
+    assert rec["timing_suspect"] is True
+    assert benchmark.bench_rates(rec)["headline.rows_per_s"] == (5e7, True)
+
+
+def test_all_committed_bench_files_parse():
+    files = sorted(glob.glob(str(REPO / "BENCH_r*.json")))
+    assert files, "no committed BENCH_r*.json"
+    for path in files:
+        rec = benchmark.load_bench_record(path)
+        assert isinstance(rec, dict)
+        rates = benchmark.bench_rates(rec)
+        assert rates, f"{path} yielded no comparable rates"
+        for name, (v, sus) in rates.items():
+            assert v > 0 and isinstance(sus, bool), (path, name)
+
+
+def test_load_bench_record_prefers_compact_line(tmp_path):
+    """A wrapper whose full line is front-truncated but whose tail keeps
+    the intact compact summary must be served from the compact line."""
+    compact = {
+        benchmark.COMPACT_MARKER: benchmark.COMPACT_SCHEMA_VERSION,
+        "metric": "rows/sec/chip", "mode": "lazy_split2", "value": 3.3e7,
+        "all_modes": {"lazy_split2": {"rows_per_s": 3.3e7,
+                                      "distortion": 3e-6,
+                                      "timing_suspect": False}},
+        "config1": {"rows_per_s": 1.6e6, "host_suspect": False},
+        "regressions": [], "regressions_vs": "BENCH_r05.json",
+    }
+    # front-truncated full line (no '{"metric"' survives) + compact line
+    tail = (
+        '_s": 123.4, "timing_suspect": false}}\n'
+        + json.dumps(compact, separators=(",", ":"))
+        + "\n"
+    )
+    p = tmp_path / "BENCH_r98.json"
+    p.write_text(json.dumps(
+        {"n": 98, "cmd": "", "rc": 0, "tail": tail, "parsed": None}
+    ))
+    rec = benchmark.load_bench_record(str(p))
+    assert rec["_from_compact_summary"]
+    assert rec["mode"] == "lazy_split2" and rec["value"] == 3.3e7
+    # an embedded regressions entry ({"metric": ...}) in the surviving
+    # tail must NOT be mistaken for the full record — the compact line
+    # still wins
+    reg_entry = json.dumps({"metric": "config3.rows_per_s",
+                            "previous": 3e6, "current": 2.5e6,
+                            "drop_pct": 16.7})
+    p2 = tmp_path / "BENCH_r97.json"
+    p2.write_text(json.dumps({
+        "n": 97, "cmd": "", "rc": 0, "parsed": None,
+        "tail": '..._s": 1.0}, "regressions": [' + reg_entry + ']}\n'
+                + json.dumps(compact, separators=(",", ":")) + "\n",
+    }))
+    rec2 = benchmark.load_bench_record(str(p2))
+    assert rec2.get("_from_compact_summary")
+    assert rec2["mode"] == "lazy_split2"
+    # a driver that parses the LAST stdout line hands us the compact
+    # digest as `parsed` — the intact full record in the tail still wins
+    full = {"metric": "rows/sec/chip", "value": 3.3e7, "mode": "lazy_split2",
+            "all_modes": {"lazy_split2": {"rows_per_s": 3.3e7,
+                                          "distortion": 3e-6,
+                                          "elapsed_s": 1.0,
+                                          "timing_suspect": False}}}
+    p3 = tmp_path / "BENCH_r96.json"
+    p3.write_text(json.dumps({
+        "n": 96, "cmd": "", "rc": 0, "parsed": compact,
+        "tail": json.dumps(full) + "\n"
+                + json.dumps(compact, separators=(",", ":")) + "\n",
+    }))
+    rec3 = benchmark.load_bench_record(str(p3))
+    assert "_from_compact_summary" not in rec3
+    assert rec3["all_modes"]["lazy_split2"]["elapsed_s"] == 1.0
+    # ...and with no full record in the tail, the parsed compact is used
+    p4 = tmp_path / "BENCH_r95.json"
+    p4.write_text(json.dumps(
+        {"n": 95, "cmd": "", "rc": 0, "parsed": compact, "tail": ""}
+    ))
+    rec4 = benchmark.load_bench_record(str(p4))
+    assert rec4.get("_from_compact_summary") and rec4["mode"] == "lazy_split2"
+    assert benchmark.bench_rates(rec)["config1.rows_per_s"] == (1.6e6, False)
+    # and the doc renderer accepts a compact-derived record
+    import sys as _sys
+
+    _sys.path.insert(0, str(REPO / "docs"))
+    try:
+        import gen_bench_tables as g
+    finally:
+        _sys.path.pop(0)
+    block = g.render(str(p))
+    assert "compact summary" in block and "lazy_split2" in block
+
+
+# -- tail-safe compact summary line (the acceptance contract) ----------------
+
+
+def _full_style_record():
+    """A record shaped like a real full-preset run (smoke-style values)."""
+    modes = {
+        n: {"rows_per_s": 1e7 * (i + 1), "distortion": 1e-6 * (i + 1),
+            "elapsed_s": 0.5, "implied_tflops": 10.0 * (i + 1),
+            "executed_tflops": 20.0 * (i + 1), "mxu_utilization": 0.1,
+            "harness_hbm_cap_rows_per_s": 4.4e7, "timing_suspect": False}
+        for i, n in enumerate(
+            ("bf16", "bf16_split2", "f32_high", "lazy", "lazy_split2",
+             "lazy_bf16")
+        )
+    }
+    return {
+        "metric": "rows/sec/chip 4096->256 (Achlioptas s=3, data-resident, "
+                  "lazy_split2)",
+        "value": 5e7, "unit": "rows/s", "vs_baseline": 12.3,
+        "cpu_baseline_rows_per_s": 4.8e6,
+        "distortion_eps_vs_cpu": 3.1e-6, "mode": "lazy_split2",
+        "all_modes": modes, "rows_timed": 100663296,
+        "implied_tflops": 70.4, "timing_suspect": False,
+        "elapsed_pass_invariant": False, "checksum": 61.5,
+        "config1": {"workload": "w", "rows_per_s": 1.6e6,
+                    "trial_spread": 1.1, "trials": 3, "host_suspect": False},
+        "config3": {"workload": "w3", "rows_per_s": 2.9e6,
+                    "distortion": 1.9e-6, "executed_tflops": 96.6,
+                    "mxu_utilization": 0.491, "timing_suspect": False},
+        "config4": {"workload": "w4", "rows_per_s": 5.3e7,
+                    "raw_kernel_rows_per_s": 6.4e7, "estimator_vs_raw": 0.83,
+                    "sign_mismatch_rate_vs_cpu": 0.0,
+                    "timing_suspect": False,
+                    "topk_serving": {"index_codes": 1 << 24, "m": 16,
+                                     "queries_per_s": 1687.3,
+                                     "timing_suspect": False,
+                                     "d2h_bytes_per_query": 128,
+                                     "dense_d2h_bytes_per_query": 1 << 26,
+                                     "executed_tflops": 14.5,
+                                     "mxu_utilization": 0.074}},
+        "config5": {"ingest_tokens_per_s": 7.2e6,
+                    "ingest_host_suspect": False,
+                    "device_sketch_docs_per_s": 8.4e5,
+                    "sketch_timing_suspect": False,
+                    "end_to_end_docs_per_s": 1.58e4,
+                    "end_to_end_serial_docs_per_s": 1.2e4,
+                    "pipeline_timing_suspect": False},
+        "preset": "full", "shape_is_default": True,
+    }
+
+
+def test_compact_line_schema_from_bench_style_invocation(capsys):
+    """Drive the real output path (cli bench → emit_bench_output) with a
+    measured-shaped record and validate the FINAL stdout line: ≤2 KB,
+    self-contained, headline mode record, per-config digests, and the
+    regressions tripwire computed against the newest committed BENCH."""
+    from randomprojection_tpu import cli
+
+    rec = benchmark.attach_regressions(_full_style_record(), root=str(REPO))
+    orig_run = benchmark.run
+    benchmark.run = lambda *a, **k: rec
+    try:
+        cli.main(["bench", "--preset", "smoke"])
+    finally:
+        benchmark.run = orig_run
+    lines = capsys.readouterr().out.splitlines()
+    assert len(lines) == 2
+    # line 1: the full record, intact
+    assert json.loads(lines[0])["mode"] == "lazy_split2"
+    # FINAL line: the compact summary
+    raw = lines[-1]
+    assert len(raw.encode()) <= benchmark.COMPACT_MAX_BYTES
+    c = json.loads(raw)
+    assert c[benchmark.COMPACT_MARKER] == benchmark.COMPACT_SCHEMA_VERSION
+    # headline mode record
+    assert c["mode"] == "lazy_split2"
+    assert c["value"] == pytest.approx(5e7)
+    assert c["all_modes"]["lazy_split2"]["rows_per_s"] == pytest.approx(5e7)
+    assert c["all_modes"]["lazy_split2"]["timing_suspect"] is False
+    # per-config digests
+    assert c["config1"]["rows_per_s"] == pytest.approx(1.6e6)
+    assert c["config4"]["estimator_vs_raw"] == pytest.approx(0.83)
+    assert c["config4"]["topk_queries_per_s"] == pytest.approx(1687.0, abs=1)
+    assert c["config5"]["end_to_end_docs_per_s"] == pytest.approx(1.58e4)
+    # the tripwire key is ALWAYS present and names its baseline
+    assert "regressions" in c and isinstance(c["regressions"], list)
+    assert c["regressions_vs"] == pathlib.Path(
+        benchmark.newest_committed_bench(str(REPO))
+    ).name
+    # round-trip: the compact line is loadable as a bench record
+    assert benchmark.find_compact_line(raw) == c
+
+
+def test_compact_summary_of_minimal_record_stays_valid():
+    c = benchmark.compact_summary({"metric": "fake", "value": 1})
+    assert c[benchmark.COMPACT_MARKER] == benchmark.COMPACT_SCHEMA_VERSION
+    assert c["regressions"] == []
+    assert len(json.dumps(c).encode()) <= benchmark.COMPACT_MAX_BYTES
