@@ -1,0 +1,36 @@
+# Repo verification entry points (ISSUE r8 satellite).
+#
+#   make verify        tier-1 suite (the ROADMAP.md command) + a doctor
+#                      smoke run, so the telemetry/report path cannot rot
+#   make tier1         just the test suite
+#   make doctor-smoke  generate a real telemetry file via the CLI and run
+#                      `doctor` on it (fails if either path breaks)
+
+SHELL := /bin/bash
+PYTHON ?= python
+SMOKE_DIR := /tmp/rp_verify
+
+.PHONY: verify tier1 doctor-smoke
+
+verify: tier1 doctor-smoke
+
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
+
+doctor-smoke:
+	rm -rf $(SMOKE_DIR) && mkdir -p $(SMOKE_DIR)
+	$(PYTHON) -c "import numpy as np; np.save('$(SMOKE_DIR)/x.npy', np.random.default_rng(0).normal(size=(256, 64)).astype(np.float32))"
+	JAX_PLATFORMS=cpu $(PYTHON) -m randomprojection_tpu project \
+	  --input $(SMOKE_DIR)/x.npy --output $(SMOKE_DIR)/y.npy \
+	  --kind gaussian --n-components 8 --backend numpy --batch-rows 64 \
+	  --telemetry-jsonl $(SMOKE_DIR)/events.jsonl \
+	  --openmetrics $(SMOKE_DIR)/metrics.om
+	JAX_PLATFORMS=cpu $(PYTHON) -m randomprojection_tpu doctor $(SMOKE_DIR)/events.jsonl
+	@grep -q '# EOF' $(SMOKE_DIR)/metrics.om || { echo 'openmetrics output missing # EOF'; exit 1; }
+	@echo "doctor-smoke OK"

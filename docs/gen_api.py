@@ -79,7 +79,7 @@ def main():
     import randomprojection_tpu.parallel as parallel
     from randomprojection_tpu.ops import hashing, pallas_kernels, split_matmul
     from randomprojection_tpu.parallel import distributed
-    from randomprojection_tpu.utils import observability, telemetry
+    from randomprojection_tpu.utils import observability, telemetry, trace_report
 
     for title, mod in [
         ("`randomprojection_tpu.streaming`", streaming),
@@ -91,6 +91,7 @@ def main():
         ("`randomprojection_tpu.ops.split_matmul`", split_matmul),
         ("`randomprojection_tpu.utils.observability`", observability),
         ("`randomprojection_tpu.utils.telemetry`", telemetry),
+        ("`randomprojection_tpu.utils.trace_report`", trace_report),
     ]:
         lines += [f"## {title}", ""]
         for name in getattr(mod, "__all__", []):

@@ -533,10 +533,14 @@ class JaxBackend(ProjectionBackend):
         )
         telemetry.registry().counter_inc("backend.dispatches")
         if telemetry.enabled():
+            # trace_fields(): inside a streamed transform the dispatch
+            # stage span is active on this thread, so the backend's own
+            # dispatch record correlates with its batch trace
             telemetry.emit(
                 "backend.dispatch", kind=spec.kind, rows=int(n),
                 n_features=spec.n_features, n_components=spec.n_components,
                 device_resident=bool(device_resident),
+                **telemetry.trace_fields(),
             )
         with annotate("rp:backend/project"):
             return self._project_prepared(x, n, state, spec), device_resident

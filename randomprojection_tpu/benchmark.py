@@ -889,6 +889,30 @@ def newest_committed_bench(root: Optional[str] = None) -> Optional[str]:
     return files[-1] if files else None
 
 
+def bench_trajectory(root: Optional[str] = None) -> list:
+    """One row per committed ``BENCH_r*.json``, oldest → newest: the
+    round-over-round trajectory the newest-vs-current tripwire cannot
+    show.  Each row is ``{"file", "mode", "rates"}`` with ``rates`` from
+    ``bench_rates`` (so every number carries its own suspect flag), or
+    ``{"file", "error"}`` for a record the loader cannot salvage — a
+    crashed round stays VISIBLE in the trajectory instead of silently
+    shortening it."""
+    rows = []
+    for path in committed_bench_paths(root):
+        name = os.path.basename(path)
+        try:
+            rec = load_bench_record(path)
+        except (ValueError, json.JSONDecodeError) as e:
+            rows.append({"file": name, "error": str(e)})
+            continue
+        rows.append({
+            "file": name,
+            "mode": rec.get("mode"),
+            "rates": bench_rates(rec),
+        })
+    return rows
+
+
 # -- regression tripwire -----------------------------------------------------
 
 

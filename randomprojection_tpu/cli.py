@@ -9,6 +9,9 @@ SURVEY.md §6 config/flag system):
 - ``bench``         the north-star data-resident metric (JSON line)
 - ``stream-bench``  host-streamed throughput (the PCIe-bound number;
                     kept separate per SURVEY.md §7)
+- ``doctor``        per-batch critical-path report from a telemetry
+                    JSONL file (alias: ``report``) — stage waterfall,
+                    bubbles, degraded-event audit, tripwire status
 """
 
 from __future__ import annotations
@@ -60,8 +63,14 @@ def _add_observability(p):
     p.add_argument("--telemetry-jsonl", default=None, metavar="PATH",
                    help="append structured telemetry events (versioned "
                         "JSONL schema — see utils/telemetry.py) for every "
-                        "pipeline stage, dispatch, commit and degraded "
-                        "retry to this file")
+                        "pipeline stage, dispatch, commit, degraded retry "
+                        "and per-batch tracing span to this file "
+                        "(analyze with the 'doctor' subcommand)")
+    p.add_argument("--openmetrics", default=None, metavar="PATH",
+                   help="after the run, write an OpenMetrics/Prometheus "
+                        "text exposition of the process metrics registry "
+                        "(counters, gauges, stage-wall histograms) to "
+                        "this file — pure text, no HTTP server")
 
 
 def _positive_int(v: str) -> int:
@@ -126,6 +135,25 @@ def build_parser():
     q.add_argument("--density", type=_density_arg, default=1.0 / 3.0,
                    help="mask density for the headline modes")
     _add_observability(q)
+
+    q = sub.add_parser(
+        "doctor", aliases=["report"],
+        help="per-batch critical-path report from a telemetry JSONL file",
+        description="Reconstruct per-batch timelines from the tracing "
+                    "spans in a --telemetry-jsonl file and print the "
+                    "critical-path waterfall (per-stage bound fraction + "
+                    "pipeline bubbles), queue-depth summary, the "
+                    "degraded-event audit (VMEM-OOM retries, dense "
+                    "fallbacks, clamps) and the regression-tripwire "
+                    "status from the newest committed bench record.  "
+                    "Tolerates crashed runs: torn tails and orphaned "
+                    "spans are counted, not fatal.",
+    )
+    q.add_argument("telemetry", metavar="TELEMETRY_JSONL",
+                   help="event file written by --telemetry-jsonl")
+    q.add_argument("--json", action="store_true",
+                   help="print the report as one JSON object instead of "
+                        "the rendered text")
 
     q = sub.add_parser("stream-bench", help="host-streamed throughput")
     q.add_argument("--rows", type=int, default=262144)
@@ -260,6 +288,7 @@ def cmd_project(args):
         np.save(out_path, Y)
         print(json.dumps({"output": out_path, "shape": list(Y.shape),
                           "dtype": str(Y.dtype), **stats.summary()}))
+        _write_openmetrics(args, stats.registry.snapshot())
         return
 
     # Checkpointed runs write through an on-disk .npy memmap so every
@@ -329,6 +358,73 @@ def cmd_project(args):
         raise SystemExit(str(e))
     print(json.dumps({"output": out_path, "shape": list(out.shape),
                       "dtype": str(out.dtype), **stats.summary()}))
+    _write_openmetrics(args, stats.registry.snapshot())
+
+
+def _write_openmetrics(args, *extra_snapshots) -> None:
+    """Write the OpenMetrics exposition when ``--openmetrics PATH`` was
+    given: the process-wide registry (backend dispatches, hash paths,
+    degraded retries) merged with any per-run registries (the stream's
+    ``StreamStats``).  Consumes the flag, so ``main``'s fallback write
+    (for commands without their own stats) fires at most once.  A file
+    write, never stdout — the bench's final-line compact-digest contract
+    must stay intact."""
+    path = getattr(args, "openmetrics", None)
+    if not path:
+        return
+    from randomprojection_tpu.utils import telemetry
+
+    with open(path, "w") as f:
+        f.write(
+            telemetry.to_openmetrics(
+                telemetry.registry().snapshot(), *extra_snapshots
+            )
+        )
+    args.openmetrics = None
+
+
+def cmd_doctor(args):
+    import os
+
+    from randomprojection_tpu.utils.trace_report import (
+        build_report,
+        render_report,
+    )
+
+    if not os.path.exists(args.telemetry):
+        raise SystemExit(f"no such telemetry file: {args.telemetry}")
+    try:
+        report = build_report(args.telemetry)
+    except (ValueError, KeyError, TypeError) as e:
+        # a torn FINAL line is tolerated by the reader; reaching here
+        # means a torn MIDDLE line (or payloads of the wrong shape) —
+        # the file is corrupt, not merely truncated
+        raise SystemExit(f"corrupt telemetry file {args.telemetry}: {e}")
+    # regression-tripwire status rides along: the newest committed bench
+    # record carries its own round-over-round verdict (benchmark.py)
+    from randomprojection_tpu import benchmark
+
+    try:
+        newest = benchmark.newest_committed_bench()
+        if newest is None:
+            report["tripwire"] = {"error": "no committed BENCH_r*.json"}
+        else:
+            rec = benchmark.load_bench_record(newest)
+            # regressions stays None (not []) when the record predates
+            # the tripwire: "no verdict recorded" must render differently
+            # from "tripwire ran and found nothing"
+            report["tripwire"] = {
+                "baseline": os.path.basename(newest),
+                "regressions": rec.get("regressions"),
+                "regressions_vs": rec.get("regressions_vs"),
+                "regressions_skipped": rec.get("regressions_skipped"),
+            }
+    except (ValueError, OSError, KeyError) as e:  # pragma: no cover
+        report["tripwire"] = {"error": f"bench record unreadable: {e}"}
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render_report(report), end="")
 
 
 def cmd_bench(args):
@@ -419,6 +515,7 @@ def cmd_stream_bench(args):
         out["pipeline_overlap_ratio"] = round(stats.overlap_ratio(), 3)
         out["queue_depth_max"] = stats.queue_depth_max
     print(json.dumps(out))
+    _write_openmetrics(args, stats.registry.snapshot())
 
 
 def main(argv=None):
@@ -458,13 +555,20 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_disable_jit", True)
-    return {
+    rv = {
         "jl-dim": cmd_jl_dim,
         "info": cmd_info,
         "project": cmd_project,
         "bench": cmd_bench,
         "stream-bench": cmd_stream_bench,
+        "doctor": cmd_doctor,
+        "report": cmd_doctor,  # alias
     }[args.cmd](args)
+    # fallback for commands that didn't write their own (e.g. bench);
+    # project/stream-bench merge their StreamStats registry in and
+    # consume the flag first
+    _write_openmetrics(args)
+    return rv
 
 
 if __name__ == "__main__":

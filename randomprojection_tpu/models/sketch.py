@@ -383,6 +383,7 @@ class SimHashIndex:
                 telemetry.emit(
                     "simhash.query_tile", queries=int(hi - lo),
                     chunks=len(self._chunks), n_codes=self.n_codes,
+                    **telemetry.trace_fields(),
                 )
         return out
 
@@ -494,6 +495,7 @@ class SimHashIndex:
                 telemetry.emit(
                     "simhash.topk_tile", queries=int(hi - lo), m=int(m_eff),
                     chunks=len(self._chunks), n_codes=self.n_codes,
+                    **telemetry.trace_fields(),
                 )
             d = np.concatenate(cand_d, axis=1)
             i = np.concatenate(cand_i, axis=1)

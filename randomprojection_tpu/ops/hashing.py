@@ -81,6 +81,7 @@ def _emit_hash_batch(path: str, n_tokens: int,
         telemetry.emit(
             "hash.batch", path=path, tokens=int(n_tokens),
             threads=threads, native=load_murmur3() is not None,
+            **telemetry.trace_fields(),
         )
 
 

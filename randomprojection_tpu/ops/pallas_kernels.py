@@ -368,6 +368,7 @@ def record_vmem_oom_retry(shape, mxu_mode: str, n_components: int) -> None:
     telemetry.emit(
         "backend.vmem_oom_retry", shape=list(shape),
         mxu_mode=mxu_mode, n_components=n_components,
+        **telemetry.trace_fields(),
     )
 
 

@@ -30,6 +30,13 @@ SURVEY.md §2 L2, §4.5).  TPU-native design:
   after the consumer has processed the yielded batch (ack-after-yield),
   and a resume recomputes any batch that was prefetched but never
   consumed.
+- **Per-batch tracing** (r8): when a telemetry sink is configured
+  (``--telemetry-jsonl``), every batch carries one trace — a root span
+  created where production starts (the prefetch worker, for an
+  overlapped pipeline) whose child spans cover hash, enqueue-wait, H2D,
+  dispatch and d2h across both threads.  ``iter_traced`` is the
+  protocol; ``utils/trace_report.py`` (surfaced as ``cli doctor``)
+  rebuilds per-batch critical-path attribution from the span stream.
 """
 
 from __future__ import annotations
@@ -59,10 +66,55 @@ __all__ = [
     "TokenSource",
     "PrefetchSource",
     "StreamCursor",
+    "iter_traced",
     "stream_transform",
     "stream_to_array",
     "stream_to_memmap",
 ]
+
+
+def iter_traced(source, start_row: int = 0):
+    """Iterate a source as ``(start_row, batch, trace_root)`` triples —
+    the tracing-aware face of ``iter_batches``.
+
+    Every batch gets ONE trace: a root span named ``batch`` opened when
+    production of that batch begins and closed by whoever finishes the
+    batch's lifecycle (``stream_transform`` ends it at commit; the plain
+    ``iter_batches`` wrappers end it when the consumer's loop body
+    returns).  Production runs with the root activated on the producing
+    thread, so instrumented stages inside the source (``TokenSource``'s
+    hash) emit correctly-parented child spans.  Sources that own a
+    producer thread implement ``iter_batches_traced`` (see
+    ``PrefetchSource``) and are deferred to — the root then travels
+    explicitly from the worker thread through the queue.  With no
+    telemetry sink installed the roots are all None and this wrapper is
+    overhead-free.
+    """
+    traced = getattr(source, "iter_batches_traced", None)
+    if traced is not None:
+        yield from traced(start_row)
+        return
+    it = source.iter_batches(start_row)
+    try:
+        while True:
+            root = telemetry.start_span("batch", new_trace=True)
+            try:
+                with telemetry.activate_span(root):
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        # production began but there was no next batch:
+                        # close the root as empty, not as an orphan
+                        telemetry.end_span(root, empty=True)
+                        return
+            except BaseException:
+                telemetry.end_span(root, error=True)
+                raise
+            yield item[0], item[1], root
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
 
 
 def _check_start_row(start_row: int, batch_rows: int, n_rows: int) -> None:
@@ -307,6 +359,33 @@ class PrefetchSource(RowBatchSource):
         self.dtype = inner.dtype
 
     def iter_batches(self, start_row: int = 0):
+        it = self.iter_batches_traced(start_row)
+        try:
+            for lo, batch, root in it:
+                try:
+                    yield lo, batch
+                finally:
+                    # direct (untraced) consumers end the batch trace when
+                    # their loop body returns; stream_transform consumes
+                    # the traced face instead and ends roots at commit
+                    telemetry.end_span(root, row=int(lo))
+        finally:
+            it.close()
+
+    def iter_batches_traced(self, start_row: int = 0):
+        """``iter_traced`` face: ``(lo, batch, trace_root)`` triples.
+
+        The batch's trace root is created ON THE WORKER THREAD when
+        production begins (so the inner source's hash span parents
+        correctly), carried through the queue, and handed to the
+        consumer — the explicit cross-thread propagation contract.  The
+        caller owns ending the root.  Worker-side child spans: the
+        inner production stages (via ``iter_traced``'s activation),
+        ``h2d`` for the prepare step, and ``enqueue_wait`` for time the
+        producer spent waiting for queue space (consumer-bound time —
+        deliberately NOT a ``StreamStats`` stage: it is idle, not work,
+        and must not inflate the overlap ratio's denominator).
+        """
         q: queue.Queue = queue.Queue(maxsize=self.depth)
         stop = threading.Event()
 
@@ -323,23 +402,38 @@ class PrefetchSource(RowBatchSource):
 
         def work():
             try:
-                for lo, batch in self._inner.iter_batches(start_row):
-                    if self.prepare is not None:
-                        with _stage(self.stats, "h2d"):
-                            batch = self.prepare(batch)
-                    depth_now = q.qsize()
-                    if self.stats is not None:
-                        # occupancy the producer found at delivery: 0 =
-                        # the consumer had drained the queue (producer-
-                        # bound), depth = full, the producer must wait
-                        # (consumer-bound)
-                        self.stats.on_queue_depth(depth_now)
-                    telemetry.emit(
-                        "stream.prefetch.deliver", row=int(lo),
-                        queue_depth=int(depth_now), capacity=self.depth,
-                    )
-                    if not _put((lo, batch)):
-                        return  # consumer went away
+                produced = iter_traced(self._inner, start_row)
+                try:
+                    for lo, batch, root in produced:
+                        if self.prepare is not None:
+                            with telemetry.activate_span(root), \
+                                    _stage(self.stats, "h2d"):
+                                batch = self.prepare(batch)
+                        depth_now = q.qsize()
+                        if self.stats is not None:
+                            # occupancy the producer found at delivery: 0 =
+                            # the consumer had drained the queue (producer-
+                            # bound), depth = full, the producer must wait
+                            # (consumer-bound)
+                            self.stats.on_queue_depth(depth_now)
+                        telemetry.emit(
+                            "stream.prefetch.deliver", row=int(lo),
+                            queue_depth=int(depth_now), capacity=self.depth,
+                            **(
+                                {"trace_id": root.trace_id}
+                                if root is not None else {}
+                            ),
+                        )
+                        with telemetry.span(
+                            "enqueue_wait", parent=root, require_parent=True,
+                        ):
+                            delivered = _put((lo, batch, root))
+                        if not delivered:
+                            # consumer went away; close the in-flight trace
+                            telemetry.end_span(root, abandoned=True)
+                            return
+                finally:
+                    produced.close()
                 _put(self._DONE)
             except BaseException as e:  # propagate to the consumer thread
                 telemetry.emit("stream.prefetch.error", error=repr(e))
@@ -378,6 +472,17 @@ class PrefetchSource(RowBatchSource):
             # leaks nothing past interpreter exit — but it is an anomaly
             # worth recording loudly.
             worker.join(timeout=5.0)
+            # batches produced into the queue but never handed to the
+            # consumer: close their traces as abandoned (resume recomputes
+            # them) so an abandoned stream leaves no orphan spans
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if isinstance(item, tuple) and len(item) == 3:
+                    telemetry.end_span(item[2], row=int(item[0]),
+                                       abandoned=True)
             if worker.is_alive():  # pragma: no cover — needs a hung read
                 from randomprojection_tpu.utils.observability import logger
 
@@ -453,16 +558,19 @@ def stream_transform(
     if stats is not None:
         stats.start()
 
-    pending: list = []  # [(start_row, n_rows, Y_lazy, in_nbytes)]
+    pending: list = []  # [(start_row, n_rows, Y_lazy, in_nbytes, trace_root)]
 
     def materialize(entry):
-        start_row, n_rows, y, in_nbytes = entry
+        start_row, n_rows, y, in_nbytes, root = entry
         if not sp.issparse(y):  # forces device→host for lazy handles
-            with annotate("rp:stream/fetch_d2h"), _stage(stats, "d2h"):
+            # re-activate the batch's trace root (created on whichever
+            # thread produced the batch) so the d2h span joins its trace
+            with telemetry.activate_span(root), \
+                    annotate("rp:stream/fetch_d2h"), _stage(stats, "d2h"):
                 y = np.asarray(y)
             if out_dtype is not None:
                 y = y.astype(out_dtype, copy=False)
-        return start_row, n_rows, y, in_nbytes
+        return start_row, n_rows, y, in_nbytes, root
 
     def emit(entry):
         # Yield the batch FIRST; advance/save the cursor (and count the
@@ -472,25 +580,45 @@ def stream_transform(
         # would let a crash inside the consumer silently drop the batch's
         # row range on resume: the cursor (or the stats log) would claim
         # rows the consumer never durably wrote.
-        start_row, n_rows, y, in_nbytes = materialize(entry)
-        yield start_row, y
-        cursor.rows_done = start_row + n_rows
-        if checkpoint_path is not None:
-            cursor.save(checkpoint_path)
-        if stats is not None:
-            stats.on_commit(start_row, in_nbytes, y)
+        start_row, n_rows, y, in_nbytes, root = materialize(entry)
+        committed = False
+        try:
+            yield start_row, y
+            cursor.rows_done = start_row + n_rows
+            if checkpoint_path is not None:
+                cursor.save(checkpoint_path)
+            with telemetry.activate_span(root):
+                # commit inside the trace so stream.commit correlates
+                if stats is not None:
+                    stats.on_commit(start_row, in_nbytes, y)
+            # the batch's trace ends at commit: production → dispatch →
+            # d2h → consumer ack, one root span per batch
+            telemetry.end_span(root, row=int(start_row), rows=int(n_rows))
+            committed = True
+        finally:
+            if not committed:
+                # consumer broke/crashed mid-yield (or the commit write
+                # failed): the batch never committed — close its trace as
+                # abandoned so a clean break is not mistaken for a crash
+                # (orphaned span) by the doctor.  The CURSOR stays put by
+                # design: resume recomputes this batch.
+                telemetry.end_span(root, row=int(start_row), abandoned=True)
 
-    batches = source.iter_batches(cursor.rows_done)
+    batches = iter_traced(source, cursor.rows_done)
     try:
-        for start_row, batch in batches:
+        for start_row, batch, root in batches:
             # _transform_async is each estimator's own (possibly overridden)
-            # transform, returning a lazy device handle where supported
-            with annotate("rp:stream/dispatch"), _stage(stats, "dispatch"):
+            # transform, returning a lazy device handle where supported;
+            # the batch's trace root is re-activated so the dispatch span
+            # (and the backend's own dispatch event) join its trace
+            with telemetry.activate_span(root), \
+                    annotate("rp:stream/dispatch"), _stage(stats, "dispatch"):
                 y = estimator._transform_async(batch)
-            telemetry.emit(
-                "stream.dispatch", row=int(start_row),
-                rows=int(getattr(batch, "shape", (0,))[0]),
-            )
+                telemetry.emit(
+                    "stream.dispatch", row=int(start_row),
+                    rows=int(getattr(batch, "shape", (0,))[0]),
+                    **telemetry.trace_fields(),
+                )
             fetch_async = getattr(y, "copy_to_host_async", None)
             if fetch_async is not None:
                 # start the d2h as soon as the device finishes this batch:
@@ -502,13 +630,19 @@ def stream_transform(
             # keep only the byte count: retaining the batch itself would pin
             # pipeline_depth extra input batches of host memory
             pending.append(
-                (start_row, batch.shape[0], y, batch_nbytes(batch))
+                (start_row, batch.shape[0], y, batch_nbytes(batch), root)
             )
             if len(pending) >= pipeline_depth:
                 yield from emit(pending.pop(0))
         while pending:
             yield from emit(pending.pop(0))
     finally:
+        # abandoned mid-flight (break or exception): close the traces of
+        # batches that were dispatched but never reached the consumer —
+        # their work is recomputed on resume, and the doctor must see a
+        # deliberate abandon, not a crash's orphaned spans
+        for entry in pending:
+            telemetry.end_span(entry[4], row=int(entry[0]), abandoned=True)
         # deterministic producer shutdown: a PrefetchSource's worker thread
         # must be stopped/joined even when the consumer abandons the stream
         # mid-flight (break or exception) — relying on GC to close the

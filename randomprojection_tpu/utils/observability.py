@@ -67,10 +67,13 @@ def batch_nbytes(batch) -> int:
 
 
 def stage(stats: Optional["StreamStats"], name: str):
-    """``stats.stage(name)`` when stats is given, else a no-op context —
-    so pipeline stages can be instrumented unconditionally."""
+    """``stats.stage(name)`` when stats is given, else a span-only
+    context — so pipeline stages can be instrumented unconditionally.
+    Either way the region emits a tracing span when a batch trace is
+    active on this thread (``require_parent``: stray stage timings
+    outside any trace never start orphan traces)."""
     if stats is None:
-        return contextlib.nullcontext()
+        return telemetry.span(name, require_parent=True)
     return stats.stage(name)
 
 
@@ -166,6 +169,7 @@ class StreamStats:
         telemetry.emit(
             "stream.commit", row=int(start_row), rows=int(n),
             bytes_in=int(bytes_in), bytes_out=int(out_bytes),
+            **telemetry.trace_fields(),
         )
         if self.log_every and self.batches % self.log_every == 0:
             logger.info(
@@ -177,14 +181,19 @@ class StreamStats:
     def stage(self, name: str):
         """Attribute the wrapped region's wall-clock to pipeline stage
         ``name``.  Thread-safe: producer stages record from the prefetch
-        worker concurrently with the consumer's dispatch/d2h stages."""
+        worker concurrently with the consumer's dispatch/d2h stages.
+        When a batch trace is active on this thread the region also
+        emits a child span (v2 schema), so the per-batch critical path
+        is reconstructable — ``require_parent`` keeps standalone stage
+        timings from opening orphan traces."""
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.registry.observe("stage." + name, dt)
-            telemetry.emit("stage.wall", stage=name, wall_s=round(dt, 6))
+        with telemetry.span(name, require_parent=True):
+            try:
+                yield
+            finally:
+                dt = time.perf_counter() - t0
+                self.registry.observe("stage." + name, dt)
+                telemetry.emit("stage.wall", stage=name, wall_s=round(dt, 6))
 
     def on_queue_depth(self, depth: int) -> None:
         """Record one prefetch-queue occupancy sample (taken by the

@@ -24,9 +24,9 @@ the hot paths pay nothing when telemetry is off.  The CLI flag
 ``--telemetry-jsonl PATH`` (``project``/``stream-bench``/``bench``)
 installs the process-wide sink.
 
-Event schema (version 1) — every line is a JSON object with:
+Event schema — every line is a JSON object with:
 
-- ``v``     int, schema version (1)
+- ``v``     int, schema version (this writer emits 2; readers accept 1-2)
 - ``ts``    float, unix seconds (``time.time()``)
 - ``event`` str, dotted event name (``stream.commit``,
   ``backend.dispatch``, ``backend.vmem_oom_retry``, ``stage.wall``,
@@ -36,7 +36,33 @@ Event schema (version 1) — every line is a JSON object with:
   lists / dicts only).
 
 The schema is append-only: new payload keys may appear, ``v`` bumps
-only if the meaning of an existing key changes.
+only when a new EVENT KIND (not just a payload key) is introduced or
+the meaning of an existing key changes.  Version history:
+
+- **v1** — flat events only (counters/stage walls/commits/retries).
+- **v2** — adds the paired tracing events ``span_start``/``span_end``
+  (the ``span()`` API below): ``span_start`` carries ``name``,
+  ``trace_id``, ``span_id`` and ``parent_id`` (null for a trace root);
+  ``span_end`` carries ``name``, ``trace_id``, ``span_id``, ``dur_s``
+  and any end-time attributes.  Ids are run-unique strings.  All other
+  events are unchanged — a v1 reader that ignores unknown event names
+  parses a v2 file minus the spans; this module's ``read_events``
+  accepts both versions, so committed v1 files keep loading.
+
+Tracing spans (v2)
+------------------
+
+``span(name, **attrs)`` is a context manager emitting a
+``span_start``/``span_end`` pair.  Nesting is tracked per thread: a
+span opened inside another becomes its child (``parent_id``).  The
+streaming pipeline gives every batch ONE trace — a root span named
+``batch`` — whose child spans cover hash, enqueue-wait, H2D, dispatch
+and d2h *whichever thread runs them*: cross-thread propagation is
+explicit — the producer (``streaming.PrefetchSource`` worker) creates
+the root and passes it through the queue; the consumer re-activates it
+(``activate_span``) around its dispatch/d2h stages.
+``utils/trace_report.py`` rebuilds per-batch timelines and critical-
+path attribution from the resulting span stream.
 """
 
 from __future__ import annotations
@@ -45,12 +71,15 @@ import contextlib
 import json
 import math
 import os
+import re
+import sys
 import threading
 import time
 from typing import Iterator, Optional
 
 __all__ = [
     "SCHEMA_VERSION",
+    "SUPPORTED_SCHEMA_VERSIONS",
     "MetricsRegistry",
     "TelemetryLog",
     "configure",
@@ -59,9 +88,20 @@ __all__ = [
     "emit",
     "parse_event",
     "read_events",
+    "Span",
+    "span",
+    "start_span",
+    "end_span",
+    "activate_span",
+    "current_span",
+    "trace_fields",
+    "to_openmetrics",
 ]
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+# readers accept every version whose events they can represent; v1 files
+# (committed telemetry fixtures, old runs) parse forever
+SUPPORTED_SCHEMA_VERSIONS = frozenset({1, 2})
 
 
 class MetricsRegistry:
@@ -306,10 +346,13 @@ _ACTIVE_LOG: Optional[TelemetryLog] = None
 def configure(path: str) -> TelemetryLog:
     """Install the process-wide JSONL sink (replacing any previous one).
     Instrumented call sites all over the package start emitting into it
-    immediately; ``shutdown()`` uninstalls and closes."""
-    global _ACTIVE_LOG
+    immediately; ``shutdown()`` uninstalls and closes.  Each configure
+    draws a fresh run token for span ids, so the runs appended to one
+    file can never collide trace ids."""
+    global _ACTIVE_LOG, _RUN_TOKEN
     if _ACTIVE_LOG is not None:
         _ACTIVE_LOG.close()
+    _RUN_TOKEN = os.urandom(4).hex()
     _ACTIVE_LOG = TelemetryLog(path)
     return _ACTIVE_LOG
 
@@ -327,12 +370,177 @@ def enabled() -> bool:
     return _ACTIVE_LOG is not None
 
 
+def _finalizing() -> bool:
+    """True when the interpreter is tearing down (or so far gone that we
+    cannot even tell).  Emitting from a daemon thread or a ``__del__``
+    at that point must drop the event, never traceback."""
+    try:
+        return sys is None or sys.is_finalizing()
+    except Exception:  # pragma: no cover — modules already demolished
+        return True
+
+
 def emit(event: str, **fields) -> None:
     """Emit one event to the process-wide sink; no-op when none is
-    installed (one global read — safe in hot paths)."""
+    installed (one global read — safe in hot paths).  Safe during
+    interpreter teardown: a late emit from a daemon thread or a
+    ``__del__`` is dropped instead of raising into the finalizer."""
     log = _ACTIVE_LOG
-    if log is not None:
+    if log is None:
+        return
+    try:
         log.emit(event, **fields)
+    except Exception:
+        if _finalizing():
+            return
+        raise
+
+
+# -- tracing spans (schema v2) ------------------------------------------------
+
+
+class Span:
+    """One in-flight span: identity + start time.  Create with
+    ``start_span``/``span``; ids are run-unique strings (run token from
+    ``configure()`` + a process-wide sequence)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "t0")
+
+    def __init__(self, name, trace_id, span_id, parent_id, t0):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = t0
+
+    def __repr__(self):  # pragma: no cover — debugging aid
+        return (
+            f"Span({self.name!r}, trace={self.trace_id}, "
+            f"span={self.span_id}, parent={self.parent_id})"
+        )
+
+
+_SPAN_TLS = threading.local()  # .current: the active Span of this thread
+_SPAN_LOCK = threading.Lock()
+_SPAN_SEQ = 0
+# run token: regenerated by configure() so ids stay unique across the
+# multiple runs that may append to one telemetry file
+_RUN_TOKEN = "0"
+
+
+def _new_span_id() -> str:
+    global _SPAN_SEQ
+    with _SPAN_LOCK:
+        _SPAN_SEQ += 1
+        return f"{_RUN_TOKEN}-{_SPAN_SEQ:x}"
+
+
+def current_span() -> Optional[Span]:
+    """This thread's innermost active span (set by ``span()`` /
+    ``activate_span``), or None."""
+    return getattr(_SPAN_TLS, "current", None)
+
+
+def trace_fields() -> dict:
+    """``{"trace_id", "span_id"}`` of the thread's active span — splice
+    into flat events (``emit(..., **trace_fields())``) so dispatches,
+    hash batches and degraded retries correlate with their batch trace.
+    Empty when no span is active (the event stays v1-shaped)."""
+    cur = current_span()
+    if cur is None:
+        return {}
+    return {"trace_id": cur.trace_id, "span_id": cur.span_id}
+
+
+def start_span(name: str, *, parent: Optional[Span] = None,
+               new_trace: bool = False, require_parent: bool = False,
+               **attrs) -> Optional[Span]:
+    """Open a span and emit its ``span_start``; returns None (a no-op
+    handle) when no sink is installed.
+
+    Parenting: explicit ``parent=`` wins; otherwise the thread's active
+    span; ``new_trace=True`` forces a fresh trace root (``parent_id``
+    null).  ``require_parent=True`` skips the span entirely when there
+    is no parent in scope — used by instrumented stages that only make
+    sense inside a batch trace.  Close with ``end_span`` (any thread).
+    """
+    if _ACTIVE_LOG is None:
+        return None
+    try:
+        if parent is None and not new_trace:
+            parent = current_span()
+        if parent is None and require_parent and not new_trace:
+            return None
+        span_id = _new_span_id()
+        if new_trace or parent is None:
+            trace_id, parent_id = span_id, None
+        else:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        s = Span(name, trace_id, span_id, parent_id, time.perf_counter())
+        emit(
+            "span_start", name=name, trace_id=trace_id, span_id=span_id,
+            parent_id=parent_id, **attrs,
+        )
+        return s
+    except Exception:
+        if _finalizing():
+            return None
+        raise
+
+
+def end_span(span_: Optional[Span], **attrs) -> None:
+    """Emit the ``span_end`` for a span returned by ``start_span`` (from
+    any thread).  None (disabled-telemetry handle) is a no-op; safe at
+    interpreter teardown."""
+    if span_ is None:
+        return
+    try:
+        emit(
+            "span_end", name=span_.name, trace_id=span_.trace_id,
+            span_id=span_.span_id,
+            dur_s=round(time.perf_counter() - span_.t0, 9), **attrs,
+        )
+    except Exception:
+        if _finalizing():
+            return
+        raise
+
+
+@contextlib.contextmanager
+def activate_span(span_: Optional[Span]):
+    """Make ``span_`` this thread's active span for the block — the
+    explicit cross-thread propagation primitive: a consumer adopting a
+    trace root the producer created re-activates it around its own
+    stages so their spans parent correctly.  Does NOT end the span.
+    None (telemetry disabled) is a cheap no-op."""
+    if span_ is None:
+        yield None
+        return
+    prev = getattr(_SPAN_TLS, "current", None)
+    _SPAN_TLS.current = span_
+    try:
+        yield span_
+    finally:
+        _SPAN_TLS.current = prev
+
+
+@contextlib.contextmanager
+def span(name: str, *, parent: Optional[Span] = None,
+         new_trace: bool = False, require_parent: bool = False, **attrs):
+    """Context manager: ``start_span`` + thread-local activation +
+    ``end_span``.  Yields the ``Span`` (None when telemetry is off)."""
+    s = start_span(
+        name, parent=parent, new_trace=new_trace,
+        require_parent=require_parent, **attrs,
+    )
+    if s is None:
+        yield None
+        return
+    try:
+        with activate_span(s):
+            yield s
+    finally:
+        end_span(s)
 
 
 def parse_event(line: str) -> dict:
@@ -345,10 +553,10 @@ def parse_event(line: str) -> dict:
         raise ValueError(f"not a JSON event line: {line!r}") from e
     if not isinstance(rec, dict):
         raise ValueError(f"event line is not an object: {line!r}")
-    if rec.get("v") != SCHEMA_VERSION:
+    if rec.get("v") not in SUPPORTED_SCHEMA_VERSIONS:
         raise ValueError(
             f"unsupported telemetry schema version {rec.get('v')!r} "
-            f"(supported: {SCHEMA_VERSION})"
+            f"(supported: {sorted(SUPPORTED_SCHEMA_VERSIONS)})"
         )
     if not isinstance(rec.get("event"), str) or not isinstance(
         rec.get("ts"), (int, float)
@@ -377,3 +585,93 @@ def read_events(path: str) -> Iterator[dict]:
                 yield parse_event(pending)
             except ValueError:  # torn final line: tolerated
                 return
+
+
+# -- OpenMetrics / Prometheus text exposition --------------------------------
+
+
+def _om_name(name: str) -> str:
+    """Metric name → OpenMetrics-legal name (``rp_`` namespace, dots and
+    other separators to underscores)."""
+    return "rp_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def _om_num(v) -> str:
+    """Render a sample value; OpenMetrics wants plain decimal/scientific
+    (repr of a Python float qualifies; ints stay ints)."""
+    if isinstance(v, bool):  # pragma: no cover — no bool metrics today
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def _merge_snapshots(snapshots) -> dict:
+    """Merge ``MetricsRegistry.snapshot()`` dicts (the default registry
+    plus per-stream registries) into one: counters and histogram
+    sums/counts/buckets add; gauges combine max-of-max, sum/n add, and
+    the later snapshot's ``last`` wins."""
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for snap in snapshots:
+        for k, v in (snap.get("counters") or {}).items():
+            out["counters"][k] = out["counters"].get(k, 0) + v
+        for k, g in (snap.get("gauges") or {}).items():
+            m = out["gauges"].setdefault(
+                k, {"last": 0, "max": 0, "sum": 0.0, "n": 0}
+            )
+            m["last"] = g["last"]
+            m["max"] = max(m["max"], g["max"]) if m["n"] else g["max"]
+            m["sum"] += g["sum"]
+            m["n"] += g["n"]
+        for k, h in (snap.get("histograms") or {}).items():
+            m = out["histograms"].setdefault(
+                k, {"sum": 0.0, "count": 0, "buckets": {}}
+            )
+            m["sum"] += h["sum"]
+            m["count"] += h["count"]
+            for b, c in (h.get("buckets") or {}).items():
+                m["buckets"][str(b)] = m["buckets"].get(str(b), 0) + c
+    return out
+
+
+def to_openmetrics(*snapshots: dict) -> str:
+    """Render one or more ``MetricsRegistry.snapshot()`` dicts as an
+    OpenMetrics/Prometheus text exposition (pure text — scrape it from a
+    file or paste it into a pushgateway; no HTTP server involved).
+
+    Mapping: counters → ``<name>_total``; gauges → three gauges
+    (``<name>`` = last sample, ``<name>_max``, ``<name>_mean``);
+    wall-clock histograms → a ``<name>_seconds`` histogram whose
+    ``le`` boundaries are the registry's fixed log2 bucket upper edges
+    (bucket *i* = ``[2^i, 2^(i+1))`` µs ⇒ ``le = 2^(i+1)·1e-6`` s),
+    cumulative per the spec, with exact ``_sum``/``_count``.  Output is
+    deterministically ordered and ends with ``# EOF``.
+    """
+    m = _merge_snapshots(snapshots)
+    lines = []
+    for name in sorted(m["counters"]):
+        om = _om_name(name)
+        lines.append(f"# TYPE {om} counter")
+        lines.append(f"{om}_total {_om_num(m['counters'][name])}")
+    for name in sorted(m["gauges"]):
+        g = m["gauges"][name]
+        om = _om_name(name)
+        mean = g["sum"] / g["n"] if g["n"] else 0.0
+        for suffix, v in (("", g["last"]), ("_max", g["max"]),
+                          ("_mean", mean)):
+            lines.append(f"# TYPE {om}{suffix} gauge")
+            lines.append(f"{om}{suffix} {_om_num(v)}")
+    for name in sorted(m["histograms"]):
+        h = m["histograms"][name]
+        om = _om_name(name) + "_seconds"
+        lines.append(f"# TYPE {om} histogram")
+        cum = 0
+        for b in sorted(int(k) for k in h["buckets"]):
+            cum += h["buckets"][str(b)]
+            le = _om_num((1 << (b + 1)) * 1e-6)
+            lines.append(f'{om}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{om}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{om}_sum {_om_num(h['sum'])}")
+        lines.append(f"{om}_count {h['count']}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,356 @@
+"""Per-batch critical-path attribution over the telemetry span stream.
+
+The r7 spine records flat events; the r8 tracing layer
+(``utils/telemetry.py`` schema v2) records one TRACE per streamed batch
+— a root span named ``batch`` with child spans for every pipeline stage
+(hash, enqueue-wait, h2d, dispatch, d2h), whichever thread ran them.
+This module turns a telemetry JSONL file back into the question the
+overlapped-ingest work actually asks: **which stage bounded each batch,
+and where are the pipeline bubbles?**
+
+``build_report(path)`` reconstructs per-batch timelines and computes:
+
+- **critical-path attribution** — within each batch trace, every
+  instant of the root interval is attributed to exactly one covering
+  child stage (ties to the earliest-started span) or, uncovered, to the
+  **bubble**; stage fractions + bubble therefore sum to exactly 100% of
+  batch wall, by construction.
+- **pipeline overlap** — run elapsed (span of all batch traces) vs the
+  summed stage wall, the same ``1 - elapsed/Σ`` shape as
+  ``StreamStats.pipeline_overlap_ratio``.
+- **queue-depth-over-time** — from ``stream.prefetch.deliver`` samples.
+- **degraded-event audit** — VMEM-OOM retries, dense fallbacks, top-k
+  block clamps, python-path hash batches, prefetch errors.
+
+Crash-tolerant by design: the reader already tolerates a torn final
+line, and spans whose ``span_end`` never made it (the run died mid-
+batch) are counted as ``orphan_starts`` and excluded from attribution
+instead of poisoning it — a doctor you can point at the telemetry file
+of the run that just crashed.
+
+``render_report(report)`` renders the stage waterfall + audit as text;
+``cli doctor <telemetry.jsonl>`` (alias ``report``) is the command-line
+face.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from randomprojection_tpu.utils.telemetry import read_events
+
+__all__ = ["build_report", "render_report", "DEGRADED_EVENTS"]
+
+# event names that mark a degraded execution path; the audit reports a
+# count for each even when zero, so "nothing degraded" is an explicit
+# statement, not an absence
+DEGRADED_EVENTS = (
+    "backend.vmem_oom_retry",
+    "simhash.topk_dense_fallback",
+    "simhash.topk_block_clamp",
+    "stream.prefetch.error",
+    "stream.prefetch.shutdown_timeout",
+)
+
+
+class _Span:
+    __slots__ = ("name", "trace_id", "parent_id", "t0", "t1")
+
+    def __init__(self, name, trace_id, parent_id, t0, t1):
+        self.name = name
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.t1 = t1
+
+
+def _attribute(root: _Span, children: list):
+    """Sweep the root interval: every elementary sub-interval goes to the
+    earliest-started covering child (one stage per instant — fractions
+    stay additive) or to the bubble.  Returns
+    ``(stage_seconds, bubble_seconds, batch_wall_seconds)``."""
+    t0, t1 = root.t0, root.t1
+    ivals = []
+    for c in children:
+        s, e = max(c.t0, t0), min(c.t1, t1)
+        if e > s:
+            ivals.append((s, e, c.name, c.t0))
+    bounds = sorted({t0, t1, *(s for s, _, _, _ in ivals),
+                     *(e for _, e, _, _ in ivals)})
+    stage_s: dict = {}
+    bubble = 0.0
+    for a, b in zip(bounds, bounds[1:]):
+        active = [iv for iv in ivals if iv[0] <= a and iv[1] >= b]
+        if active:
+            winner = min(active, key=lambda iv: (iv[3], iv[2]))[2]
+            stage_s[winner] = stage_s.get(winner, 0.0) + (b - a)
+        else:
+            bubble += b - a
+    return stage_s, bubble, t1 - t0
+
+
+def build_report(path: str) -> dict:
+    """Reconstruct per-batch timelines from a telemetry JSONL file and
+    return the critical-path report (plain-JSON dict).
+
+    Tolerates everything a crashed run leaves behind: a torn final line
+    (skipped by the reader), ``span_start``s with no end (counted as
+    orphans, excluded from attribution), span events missing their ids
+    (counted as malformed, skipped), traces whose root was lost, and
+    files with no spans at all (flat v1 logs — the report then carries
+    only the event counts and the audit).
+
+    Single streaming pass: a trace is attributed and dropped the moment
+    its ROOT span ends (children always end before the root in the
+    pipeline's trace shape), so memory is bounded by in-flight traces
+    plus whatever a crash orphaned — a multi-GB event log never has to
+    fit in host memory, matching ``read_events``' own O(1) contract."""
+    starts: dict = {}          # span_id -> span_start event (unclosed)
+    children_of: dict = {}     # trace_id -> [completed child _Span]
+    event_counts: dict = {}
+    orphan_ends = 0
+    malformed_spans = 0
+    complete_spans = 0
+    hash_python = 0
+    n_events = 0
+    queue_n = 0
+    queue_max = 0
+    queue_sum = 0.0
+    queue_capacity: Optional[int] = None
+
+    stage_total: dict = {}
+    bubble_total = 0.0
+    wall_total = 0.0
+    n_batches = 0
+    incomplete = 0
+    empty_roots = 0
+    t_min, t_max = None, None
+    child_wall = 0.0
+
+    for e in read_events(path):
+        n_events += 1
+        name = e["event"]
+        event_counts[name] = event_counts.get(name, 0) + 1
+        if name == "span_start":
+            if "span_id" not in e or "trace_id" not in e:
+                malformed_spans += 1
+                continue
+            starts[e["span_id"]] = e
+        elif name == "span_end":
+            if "span_id" not in e:
+                malformed_spans += 1
+                continue
+            s = starts.pop(e["span_id"], None)
+            if s is None:
+                orphan_ends += 1
+                continue
+            complete_spans += 1
+            t0 = s["ts"]
+            # prefer the monotonic duration over wall-clock subtraction:
+            # ts comes from time.time(), dur_s from perf_counter
+            t1 = t0 + e.get("dur_s", max(e["ts"] - t0, 0.0))
+            trace_id = s["trace_id"]
+            if s.get("parent_id") is not None:
+                children_of.setdefault(trace_id, []).append(
+                    _Span(s["name"], trace_id, s["parent_id"], t0, t1)
+                )
+                continue
+            # a ROOT ended: finalize its trace now and drop the buffers
+            children = children_of.pop(trace_id, [])
+            if e.get("empty"):
+                # iter_traced's end-of-stream probe: production began but
+                # there was no next batch — a healthy artifact, not an
+                # incomplete batch
+                empty_roots += 1
+                continue
+            if e.get("error") or e.get("abandoned"):
+                incomplete += 1
+                continue
+            root = _Span(s["name"], trace_id, None, t0, t1)
+            n_batches += 1
+            t_min = root.t0 if t_min is None else min(t_min, root.t0)
+            t_max = root.t1 if t_max is None else max(t_max, root.t1)
+            child_wall += sum(c.t1 - c.t0 for c in children)
+            stage_s, bubble, wall = _attribute(root, children)
+            for k, v in stage_s.items():
+                stage_total[k] = stage_total.get(k, 0.0) + v
+            bubble_total += bubble
+            wall_total += wall
+        elif name == "stream.prefetch.deliver":
+            d = e.get("queue_depth", 0)
+            queue_n += 1
+            queue_max = max(queue_max, d)
+            queue_sum += d
+            if queue_capacity is None:
+                queue_capacity = e.get("capacity")
+        elif name == "hash.batch" and e.get("path") == "python":
+            hash_python += 1
+
+    # traces whose root never ended: their buffered children are orphaned
+    # work of a crashed run — count the traces as incomplete
+    incomplete += len(children_of)
+
+    stages = {
+        name: {
+            "wall_s": round(secs, 6),
+            "pct": round(100.0 * secs / wall_total, 2) if wall_total else 0.0,
+        }
+        for name, secs in sorted(stage_total.items())
+    }
+    elapsed = (t_max - t_min) if (t_min is not None) else 0.0
+    overlap = (
+        max(0.0, 1.0 - elapsed / child_wall) if child_wall > 0 else 0.0
+    )
+    degraded = {name: event_counts.get(name, 0) for name in DEGRADED_EVENTS}
+    degraded["hash.batch[path=python]"] = hash_python
+    queue = None
+    if queue_n:
+        queue = {
+            "samples": queue_n,
+            "max": queue_max,
+            "mean": round(queue_sum / queue_n, 3),
+            "capacity": queue_capacity,
+        }
+    return {
+        "file": path,
+        "events": n_events,
+        "event_counts": dict(sorted(event_counts.items())),
+        "spans": {
+            "complete": complete_spans,
+            "orphan_starts": len(starts),
+            "orphan_ends": orphan_ends,
+            "malformed": malformed_spans,
+        },
+        "traces": {
+            "batches": n_batches,
+            "incomplete": incomplete,
+            "empty": empty_roots,
+        },
+        "batch": {
+            "wall_s": round(wall_total, 6),
+            "stages": stages,
+            "bubble": {
+                "wall_s": round(bubble_total, 6),
+                "pct": (
+                    round(100.0 * bubble_total / wall_total, 2)
+                    if wall_total else 0.0
+                ),
+            },
+        },
+        "pipeline": {
+            "elapsed_s": round(elapsed, 6),
+            "stage_wall_s": round(child_wall, 6),
+            "overlap_ratio_est": round(overlap, 3),
+        },
+        "queue_depth": queue,
+        "degraded": degraded,
+    }
+
+
+def _bar(pct: float, width: int = 28) -> str:
+    n = int(round(pct / 100.0 * width))
+    return "#" * n + "." * (width - n)
+
+
+def render_report(report: dict) -> str:
+    """Human-readable doctor view: stage waterfall, bubble, pipeline
+    overlap, queue depth, degraded-event audit, and (when the caller
+    attached one — see ``cli.cmd_doctor``) the regression-tripwire
+    status."""
+    lines = []
+    tr = report["traces"]
+    sp = report["spans"]
+    lines.append(
+        f"run doctor: {report['file']} — {report['events']} events, "
+        f"{tr['batches']} batch traces"
+        + (f" ({tr['incomplete']} incomplete)" if tr["incomplete"] else "")
+        + (
+            f", {sp['orphan_starts']} orphaned span(s)"
+            if sp["orphan_starts"] else ""
+        )
+    )
+    b = report["batch"]
+    if tr["batches"]:
+        lines.append("")
+        lines.append(
+            f"per-batch critical path (% of {b['wall_s']:.4f}s total "
+            "batch wall):"
+        )
+        rows = list(b["stages"].items()) + [("(bubble)", b["bubble"])]
+        for name, d in rows:
+            lines.append(
+                f"  {name:<14} {_bar(d['pct'])} {d['pct']:6.2f}%  "
+                f"{d['wall_s']:.4f}s"
+            )
+        total_pct = sum(d["pct"] for _, d in rows)
+        lines.append(f"  {'':14} stages + bubble = {total_pct:.1f}% of "
+                     "batch wall")
+        p = report["pipeline"]
+        lines.append("")
+        lines.append(
+            f"pipeline: elapsed {p['elapsed_s']:.4f}s over "
+            f"{p['stage_wall_s']:.4f}s summed stage wall -> overlap ratio "
+            f"~{p['overlap_ratio_est']:.3f}"
+        )
+    else:
+        lines.append("")
+        lines.append(
+            "no complete batch traces (flat v1 log, or the run died before "
+            "any batch committed) — audit below still applies"
+        )
+    q = report.get("queue_depth")
+    if q:
+        lines.append(
+            f"prefetch queue: {q['samples']} samples, depth max {q['max']}"
+            f"/mean {q['mean']}"
+            + (f" (capacity {q['capacity']})" if q.get("capacity") else "")
+        )
+    lines.append("")
+    lines.append("degraded-event audit:")
+    worst = [(k, v) for k, v in report["degraded"].items() if v]
+    for k, v in report["degraded"].items():
+        lines.append(f"  {k:<36} {v}")
+    lines.append(
+        "  -> " + (
+            "DEGRADED paths taken: " + ", ".join(k for k, _ in worst)
+            if worst else "no degraded paths recorded"
+        )
+    )
+    tw = report.get("tripwire")
+    if tw is not None:
+        lines.append("")
+        if tw.get("error"):
+            lines.append(f"regression tripwire: unavailable ({tw['error']})")
+        else:
+            regs = tw.get("regressions")
+            vs = tw.get("regressions_vs")
+            if regs:
+                lines.append(
+                    f"regression tripwire ({tw['baseline']}): "
+                    f"{len(regs)} recorded vs {vs}:"
+                )
+                for r in regs:
+                    lines.append(
+                        f"  {r['metric']}: {r['previous']} -> {r['current']} "
+                        f"(-{r['drop_pct']}%)"
+                    )
+            elif tw.get("regressions_skipped"):
+                lines.append(
+                    f"regression tripwire ({tw['baseline']}): skipped — "
+                    f"{tw['regressions_skipped']}"
+                )
+            elif regs == [] and vs:
+                # the tripwire actually RAN in that round and compared
+                # clean against a named baseline
+                lines.append(
+                    f"regression tripwire ({tw['baseline']}): no >10% "
+                    f"drops recorded vs {vs}"
+                )
+            else:
+                # record predates the tripwire (no verdict on file): say
+                # so — never report a comparison that was never computed
+                lines.append(
+                    f"regression tripwire ({tw['baseline']}): no verdict "
+                    "recorded in that round's record"
+                )
+    return "\n".join(lines) + "\n"

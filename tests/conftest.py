@@ -42,3 +42,82 @@ if _FORCE_CPU:
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# mesh-path capability probe (ISSUE r8 satellite)
+#
+# Some installed jax versions cannot run this repo's mesh path on the
+# virtual CPU mesh (e.g. jax 0.4.37 has no top-level ``jax.shard_map``,
+# and its ``.at[...].get`` lacks ``out_sharding`` — the ragged-tail mesh
+# slice).  Those are ENVIRONMENT failures, not code regressions, and a
+# permanently red tier-1 masks real breakage.  Tests that exercise the
+# mesh path carry ``@pytest.mark.mesh_env``; before each one runs, the
+# probe below actually EXECUTES a tiny version of both capabilities and
+# skips — with the captured error as the reason — only when the
+# environment genuinely cannot run them.  On a compatible jax the probe
+# passes and every marked test runs: nothing is silently skipped.
+# ---------------------------------------------------------------------------
+
+_MESH_ENV_REASON: list = []  # memo cell: [] = not probed, [None|str] = result
+
+
+def _mesh_env_reason():
+    """None when the installed jax can run the repo's mesh path on the
+    virtual mesh; else a one-line reason.  Probes by execution (never by
+    version sniffing): a tiny ``jax.shard_map`` psum program and the
+    ragged ``.at[:n].get(out_sharding=...)`` gather that
+    ``slice_rows_sharded`` needs for non-divisible row counts."""
+    if _MESH_ENV_REASON:
+        return _MESH_ENV_REASON[0]
+    reason = None
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from randomprojection_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+        fn = jax.jit(
+            jax.shard_map(
+                lambda x: jax.lax.psum(x.sum(), "data") + x,
+                mesh=mesh, in_specs=(P("data", None),),
+                out_specs=P("data", None),
+            )
+        )
+        x = jnp.arange(8.0).reshape(4, 2)
+        np.testing.assert_allclose(
+            np.asarray(fn(x)), np.asarray(x) + float(x.sum())
+        )
+        # the ragged mesh slice: XLA cannot slice a sharded dim to a
+        # non-divisible size, so slice_rows_sharded gathers replicated
+        y = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+        np.testing.assert_allclose(
+            np.asarray(y.at[:3].get(out_sharding=NamedSharding(mesh, P()))),
+            np.asarray(x)[:3],
+        )
+    except Exception as e:
+        reason = f"{type(e).__name__}: {e}"
+        reason = reason.splitlines()[0][:200]
+    _MESH_ENV_REASON.append(reason)
+    return reason
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "mesh_env: needs a jax that can run shard_map (and the ragged "
+        "out_sharding slice) on the virtual mesh; skipped with the "
+        "probe's captured error when the installed jax cannot",
+    )
+
+
+def pytest_runtest_setup(item):
+    if item.get_closest_marker("mesh_env") is not None:
+        reason = _mesh_env_reason()
+        if reason is not None:
+            pytest.skip(
+                "installed jax cannot run the shard_map mesh path on the "
+                f"virtual mesh: {reason}"
+            )

@@ -290,6 +290,7 @@ print(json.dumps({"pid": pid, "ok": True}))
 """
 
 
+@pytest.mark.mesh_env
 def test_pod_topology_two_process_mesh(tmp_path):
     """The real pod shape (VERDICT r3 missing #4): 2 processes x 4 devices
     = one global 8-device mesh through jax.distributed.  DP rows, a TP

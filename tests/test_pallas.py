@@ -271,6 +271,7 @@ def _require_8_devices():
         pytest.skip("needs the 8-device virtual CPU mesh (default suite)")
 
 
+@pytest.mark.mesh_env
 def test_lazy_tp_shard_map_abstract_eval():
     """DP×TP lazy path structure on the 8-device CPU mesh: the shard_map'd
     kernel with per-shard block offsets must trace and produce the right

@@ -353,6 +353,7 @@ def test_countsketch_csr_kernel_selection_both_match_host(monkeypatch, force):
     np.testing.assert_allclose(Y, ref, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.mesh_env
 def test_countsketch_csr_docmajor_mesh_matches(monkeypatch):
     """Doc-major kernel under the 8-device mesh: row-sharded DP, same
     values as single-device and host."""

@@ -1,0 +1,612 @@
+"""Tracing spans + run doctor (ISSUE r8): span API, cross-thread
+propagation through ``PrefetchSource``, the critical-path report on
+clean AND torn/orphaned files, the ``cli doctor`` end-to-end contract,
+the OpenMetrics exposition, schema v1/v2 compatibility, and
+teardown-safety of ``emit``/spans."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import (
+    parse_event,
+    read_events,
+    to_openmetrics,
+)
+from randomprojection_tpu.utils.trace_report import build_report, render_report
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _no_global_sink():
+    yield
+    telemetry.shutdown()
+
+
+# -- span API ----------------------------------------------------------------
+
+
+def test_span_pairing_nesting_and_ids(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    telemetry.configure(p)
+    with telemetry.span("batch", new_trace=True, row=7) as root:
+        assert telemetry.current_span() is root
+        with telemetry.span("hash") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+    assert telemetry.current_span() is None
+    telemetry.shutdown()
+    evs = list(read_events(p))
+    assert [e["event"] for e in evs] == [
+        "span_start", "span_start", "span_end", "span_end",
+    ]
+    assert all(e["v"] == telemetry.SCHEMA_VERSION for e in evs)
+    start_root, start_child, end_child, end_root = evs
+    assert start_root["parent_id"] is None
+    assert start_root["trace_id"] == start_root["span_id"]
+    assert start_root["row"] == 7
+    assert start_child["parent_id"] == start_root["span_id"]
+    assert end_child["span_id"] == start_child["span_id"]
+    assert end_child["dur_s"] >= 0
+    assert end_root["name"] == "batch"
+
+
+def test_span_noop_without_sink():
+    telemetry.shutdown()
+    assert telemetry.start_span("x") is None
+    telemetry.end_span(None)  # must not raise
+    with telemetry.span("y") as s:
+        assert s is None
+    assert telemetry.trace_fields() == {}
+
+
+def test_span_require_parent(tmp_path):
+    """Instrumented stages must not open orphan traces when no batch
+    trace is active — and must nest when one is."""
+    p = str(tmp_path / "t.jsonl")
+    telemetry.configure(p)
+    with telemetry.span("dispatch", require_parent=True) as s:
+        assert s is None  # no parent in scope: skipped entirely
+    with telemetry.span("batch", new_trace=True) as root:
+        with telemetry.span("dispatch", require_parent=True) as s:
+            assert s is not None and s.parent_id == root.span_id
+    telemetry.shutdown()
+    starts = [e for e in read_events(p) if e["event"] == "span_start"]
+    assert [e["name"] for e in starts] == ["batch", "dispatch"]
+
+
+def test_activate_span_cross_thread_adoption(tmp_path):
+    """The explicit propagation primitive: a root created on one thread,
+    adopted on another — the child parents to the foreign root."""
+    import threading
+
+    p = str(tmp_path / "t.jsonl")
+    telemetry.configure(p)
+    root = telemetry.start_span("batch", new_trace=True)
+
+    def consumer():
+        with telemetry.activate_span(root):
+            with telemetry.span("d2h"):
+                pass
+        assert telemetry.current_span() is None
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    t.join()
+    telemetry.end_span(root, row=0)
+    telemetry.shutdown()
+    starts = {e["name"]: e for e in read_events(p)
+              if e["event"] == "span_start"}
+    assert starts["d2h"]["parent_id"] == root.span_id
+    assert starts["d2h"]["trace_id"] == root.trace_id
+
+
+# -- schema compatibility (satellite) ----------------------------------------
+
+# FROZEN v1 fixture line — byte-for-byte what an r7 TelemetryLog wrote.
+# Do not regenerate from code: the point is that committed v1 files keep
+# parsing after the v2 (span) bump.
+_V1_FIXTURE = (
+    '{"v":1,"ts":1722700000.123456,"event":"stream.commit",'
+    '"row":4096,"rows":4096,"bytes_in":1048576,"bytes_out":262144}'
+)
+
+
+def test_v1_fixture_line_still_parses():
+    rec = parse_event(_V1_FIXTURE)
+    assert rec["v"] == 1 and rec["event"] == "stream.commit"
+    assert rec["rows"] == 4096
+
+
+def test_v1_and_v2_lines_coexist_in_one_file(tmp_path):
+    """A file a v1 run appended to and a v2 run continued must read end
+    to end — the real multi-run telemetry-file shape."""
+    p = tmp_path / "mixed.jsonl"
+    p.write_text(_V1_FIXTURE + "\n")
+    telemetry.configure(str(p))
+    with telemetry.span("batch", new_trace=True):
+        pass
+    telemetry.emit("stream.commit", row=0, rows=1)
+    telemetry.shutdown()
+    evs = list(read_events(str(p)))
+    assert [e["v"] for e in evs] == [1, 2, 2, 2]
+    assert evs[0]["event"] == "stream.commit"
+    assert {e["event"] for e in evs[1:]} == {
+        "span_start", "span_end", "stream.commit",
+    }
+
+
+def test_unsupported_version_still_rejected():
+    with pytest.raises(ValueError, match="version"):
+        parse_event(json.dumps({"v": 3, "ts": 0.0, "event": "x"}))
+
+
+# -- propagation through PrefetchSource (satellite) --------------------------
+
+
+def _run_token_pipeline(tel_path, n_docs=96, batch_rows=32):
+    from randomprojection_tpu.models.sketch import CountSketch
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+    from randomprojection_tpu.streaming import (
+        PrefetchSource,
+        TokenSource,
+        stream_transform,
+    )
+    from randomprojection_tpu.utils.observability import StreamStats
+
+    telemetry.configure(tel_path)
+    words = np.asarray([f"w{i}" for i in range(500)])
+
+    def read_tokens(lo, hi):
+        rng = np.random.default_rng(lo + 1)
+        toks = words[rng.integers(0, len(words), size=(hi - lo) * 8)]
+        return toks, np.arange(0, (hi - lo) * 8 + 1, 8)
+
+    fh = FeatureHasher(1 << 12, input_type="string", dtype=np.float32)
+    stats = StreamStats()
+    source = PrefetchSource(
+        TokenSource(read_tokens, n_docs, fh, batch_rows=batch_rows,
+                    stats=stats),
+        depth=2, stats=stats,
+    )
+    cs = CountSketch(16, random_state=0, backend="numpy").fit_source(source)
+    rows = sum(
+        y.shape[0] for _, y in stream_transform(cs, source, stats=stats)
+    )
+    telemetry.shutdown()
+    assert rows == n_docs
+    return stats
+
+
+def test_prefetch_span_propagation_and_no_leakage(tmp_path):
+    """Every batch gets ONE trace whose children cover the producer-side
+    stages (hash on the worker thread, enqueue-wait) AND the consumer-
+    side stages (dispatch, d2h) — correct parent linkage across the
+    thread boundary, and no child ever lands in another batch's trace."""
+    tel = str(tmp_path / "ev.jsonl")
+    _run_token_pipeline(tel)
+    evs = list(read_events(tel))
+    starts = {e["span_id"]: e for e in evs if e["event"] == "span_start"}
+    ends = {e["span_id"]: e for e in evs if e["event"] == "span_end"}
+    assert set(starts) == set(ends), "clean run must orphan no spans"
+
+    roots = [e for e in starts.values() if e["parent_id"] is None]
+    assert all(e["name"] == "batch" for e in roots)
+    committed = [
+        ends[r["span_id"]] for r in roots
+        if "row" in ends[r["span_id"]]
+    ]
+    assert len(committed) == 3  # 96 docs / 32 per batch
+    assert sorted(e["row"] for e in committed) == [0, 32, 64]
+
+    # per-trace child sets: production + queue + consumer stages, each
+    # parented to THAT trace's root
+    by_trace = {}
+    for e in starts.values():
+        if e["parent_id"] is not None:
+            assert starts[e["parent_id"]]["name"] == "batch"
+            assert starts[e["parent_id"]]["trace_id"] == e["trace_id"]
+            by_trace.setdefault(e["trace_id"], []).append(e["name"])
+    committed_traces = {e["trace_id"] for e in committed}
+    assert set(by_trace) == committed_traces
+    for names in by_trace.values():
+        assert set(names) == {"hash", "enqueue_wait", "dispatch", "d2h"}
+        assert len(names) == 4, "exactly one span per stage per batch"
+
+    # cross-batch leakage check via the flat events: the commit/dispatch
+    # events carry their trace id, and the row they record must match the
+    # row the trace's ROOT committed
+    root_rows = {e["trace_id"]: e["row"] for e in committed}
+    for e in evs:
+        if e["event"] in ("stream.commit", "stream.dispatch") \
+                and "trace_id" in e:
+            assert root_rows[e["trace_id"]] == e["row"]
+    # hash batches correlate with the trace that hashed them
+    hash_evs = [e for e in evs if e["event"] == "hash.batch"]
+    assert all("trace_id" in e for e in hash_evs)
+    assert {e["trace_id"] for e in hash_evs} <= set(root_rows) | {
+        r["trace_id"] for r in roots
+    }
+
+
+def test_report_on_clean_run_sums_to_batch_wall(tmp_path):
+    tel = str(tmp_path / "ev.jsonl")
+    stats = _run_token_pipeline(tel)
+    report = build_report(tel)
+    assert report["traces"]["batches"] == 3
+    assert report["spans"]["orphan_starts"] == 0
+    stages = report["batch"]["stages"]
+    assert {"hash", "dispatch", "d2h"} <= set(stages)
+    total_pct = sum(d["pct"] for d in stages.values())
+    total_pct += report["batch"]["bubble"]["pct"]
+    assert total_pct == pytest.approx(100.0, abs=0.5)
+    # stage walls in the report agree with StreamStats' own attribution
+    # (same regions, measured independently) to within clock noise
+    for name in ("hash", "dispatch", "d2h"):
+        assert stages[name]["wall_s"] == pytest.approx(
+            stats.stage_wall[name], rel=0.5, abs=0.05
+        )
+    assert 0.0 <= report["pipeline"]["overlap_ratio_est"] < 1.0
+    assert report["queue_depth"]["samples"] == 3
+    assert report["degraded"]["backend.vmem_oom_retry"] == 0
+    # renders without error and names every section
+    text = render_report(report)
+    assert "critical path" in text and "degraded-event audit" in text
+
+
+def test_report_tolerates_torn_tail_and_orphans(tmp_path):
+    """The doctor must work on the file a CRASHED run left behind: a torn
+    final line plus span_starts whose ends never made it."""
+    tel = str(tmp_path / "ev.jsonl")
+    _run_token_pipeline(tel)
+    raw = open(tel).read().rstrip("\n").splitlines()
+    # a batch that died mid-flight: start with no end, two of them
+    orphan1 = json.dumps({
+        "v": 2, "ts": 9e9, "event": "span_start", "name": "batch",
+        "trace_id": "dead-1", "span_id": "dead-1", "parent_id": None,
+    })
+    orphan2 = json.dumps({
+        "v": 2, "ts": 9e9, "event": "span_start", "name": "hash",
+        "trace_id": "dead-1", "span_id": "dead-2", "parent_id": "dead-1",
+    })
+    torn = raw[-1][: len(raw[-1]) // 2]  # crash mid-write of the last event
+    open(tel, "w").write("\n".join(raw[:-1] + [orphan1, orphan2, torn]))
+    report = build_report(tel)
+    # 2 injected orphans + the span whose end was on the torn final line
+    # (a clean run's last event is the final batch root's span_end)
+    assert report["spans"]["orphan_starts"] == 3
+    # the healthy batches still attribute; percentages still close
+    assert report["traces"]["batches"] == 2
+    total = sum(d["pct"] for d in report["batch"]["stages"].values())
+    total += report["batch"]["bubble"]["pct"]
+    assert total == pytest.approx(100.0, abs=0.5)
+    text = render_report(report)
+    assert "orphaned span" in text
+
+
+def test_clean_break_leaves_no_orphans_and_healthy_runs_no_incomplete(
+    tmp_path,
+):
+    """A consumer `break` is a deliberate abandon, not a crash: every
+    in-flight trace (mid-yield, pending, queued ahead by the worker) is
+    CLOSED as abandoned — the doctor must not show orphaned spans for
+    it.  And a fully-healthy run reports zero incomplete traces (the
+    end-of-stream production probe is counted as `empty`, separately)."""
+    from randomprojection_tpu import GaussianRandomProjection
+    from randomprojection_tpu.streaming import (
+        ArraySource,
+        PrefetchSource,
+        stream_transform,
+    )
+
+    X = np.random.default_rng(0).normal(size=(1000, 128)).astype(np.float32)
+    est = GaussianRandomProjection(16, random_state=0, backend="numpy").fit(X)
+
+    tel = str(tmp_path / "break.jsonl")
+    telemetry.configure(tel)
+    for i, _ in enumerate(
+        stream_transform(est, PrefetchSource(ArraySource(X, 128), depth=4))
+    ):
+        if i == 1:
+            break
+    telemetry.shutdown()
+    r = build_report(tel)
+    assert r["spans"]["orphan_starts"] == 0
+    assert r["traces"]["batches"] >= 1
+    assert r["traces"]["incomplete"] >= 1  # the abandoned in-flight batches
+
+    tel2 = str(tmp_path / "healthy.jsonl")
+    telemetry.configure(tel2)
+    for _ in stream_transform(est, ArraySource(X, 128)):
+        pass
+    telemetry.shutdown()
+    r2 = build_report(tel2)
+    assert r2["traces"] == {"batches": 8, "incomplete": 0, "empty": 1}
+    assert "incomplete" not in render_report(r2).splitlines()[0]
+
+
+def test_report_skips_malformed_span_events(tmp_path):
+    """Span events missing their ids (foreign tooling, hand edits) are
+    counted as malformed and skipped — never a KeyError out of the
+    doctor."""
+    p = tmp_path / "weird.jsonl"
+    p.write_text(
+        json.dumps({"v": 2, "ts": 1.0, "event": "span_start",
+                    "name": "batch"}) + "\n"
+        + json.dumps({"v": 2, "ts": 2.0, "event": "span_end",
+                      "name": "batch"}) + "\n"
+    )
+    r = build_report(str(p))
+    assert r["spans"]["malformed"] == 2
+    assert r["traces"]["batches"] == 0
+
+
+def test_report_on_flat_v1_log(tmp_path):
+    """A spanless (v1-era) file must produce an audit-only report, not a
+    crash."""
+    p = tmp_path / "v1.jsonl"
+    p.write_text(
+        _V1_FIXTURE + "\n" + json.dumps({
+            "v": 1, "ts": 1.0, "event": "backend.vmem_oom_retry",
+            "shape": [128, 4096], "mxu_mode": "split2",
+        }) + "\n"
+    )
+    report = build_report(str(p))
+    assert report["traces"]["batches"] == 0
+    assert report["degraded"]["backend.vmem_oom_retry"] == 1
+    text = render_report(report)
+    assert "no complete batch traces" in text
+    assert "DEGRADED paths taken: backend.vmem_oom_retry" in text
+
+
+# -- cli doctor end-to-end (the acceptance contract) -------------------------
+
+
+def test_cli_doctor_on_real_stream_bench_run(tmp_path, capsys):
+    """Acceptance: `cli doctor` on a fresh `stream-bench --telemetry-jsonl`
+    run prints per-stage critical-path percentages summing to ~100% of
+    batch wall, a bubble total consistent with the run's own
+    pipeline_overlap_ratio accounting, and the degraded-event audit."""
+    from randomprojection_tpu import cli
+
+    tel = str(tmp_path / "ev.jsonl")
+    cli.main([
+        "stream-bench", "--rows", "512", "--batch-rows", "128",
+        "--d", "64", "--k", "16", "--backend", "numpy",
+        "--prefetch-batches", "2", "--telemetry-jsonl", tel,
+    ])
+    telemetry.shutdown()  # release the sink the CLI installed
+    bench_line = json.loads(capsys.readouterr().out.splitlines()[-1])
+
+    cli.main(["doctor", tel, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert report["traces"]["batches"] == 4  # 512 rows / 128
+    stages = report["batch"]["stages"]
+    assert {"dispatch", "d2h"} <= set(stages)
+    total_pct = sum(d["pct"] for d in stages.values())
+    total_pct += report["batch"]["bubble"]["pct"]
+    assert total_pct == pytest.approx(100.0, abs=0.5)
+    # bubble consistency with the run's own overlap accounting: covered
+    # stage time cannot exceed the summed stage walls the bench reported,
+    # and bubble = batch wall − covered, all non-negative
+    covered = sum(d["wall_s"] for d in stages.values())
+    bubble = report["batch"]["bubble"]["wall_s"]
+    wall = report["batch"]["wall_s"]
+    # each field is independently rounded to 6 decimals in the report
+    assert covered + bubble == pytest.approx(wall, abs=1e-4)
+    reported_stage_sum = sum(bench_line["stage_wall_s"].values())
+    assert covered <= reported_stage_sum * 1.5 + 0.05
+    assert 0.0 <= report["pipeline"]["overlap_ratio_est"] < 1.0
+    assert "degraded" in report and "tripwire" in report
+
+    # the human rendering carries the waterfall + audit + tripwire
+    cli.main(["report", tel])  # alias must resolve too
+    text = capsys.readouterr().out
+    assert "critical path" in text
+    assert "(bubble)" in text
+    assert "degraded-event audit:" in text
+    assert "regression tripwire" in text
+
+
+def test_tripwire_rendering_distinguishes_no_verdict_from_clean(tmp_path):
+    """A baseline record that predates the tripwire (no regressions key)
+    must render as 'no verdict recorded' — never as a clean comparison
+    that was never computed; a record whose tripwire RAN and found
+    nothing names its baseline."""
+    base = {"file": "x", "events": 0, "event_counts": {},
+            "spans": {"complete": 0, "orphan_starts": 0, "orphan_ends": 0,
+                      "malformed": 0},
+            "traces": {"batches": 0, "incomplete": 0, "empty": 0},
+            "batch": {"wall_s": 0, "stages": {},
+                      "bubble": {"wall_s": 0, "pct": 0}},
+            "pipeline": {"elapsed_s": 0, "stage_wall_s": 0,
+                         "overlap_ratio_est": 0},
+            "queue_depth": None,
+            "degraded": {}}
+    pre = dict(base, tripwire={"baseline": "BENCH_r05.json",
+                               "regressions": None, "regressions_vs": None,
+                               "regressions_skipped": None})
+    assert "no verdict recorded" in render_report(pre)
+    clean = dict(base, tripwire={"baseline": "BENCH_r06.json",
+                                 "regressions": [],
+                                 "regressions_vs": "BENCH_r05.json",
+                                 "regressions_skipped": None})
+    text = render_report(clean)
+    assert "no >10% drops recorded vs BENCH_r05.json" in text
+
+
+def test_cli_doctor_missing_and_corrupt_files(tmp_path):
+    from randomprojection_tpu import cli
+
+    with pytest.raises(SystemExit, match="no such telemetry file"):
+        cli.main(["doctor", str(tmp_path / "nope.jsonl")])
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"v":2,"ts":1.0,"eve\n'
+                   '{"v":2,"ts":2.0,"event":"x"}\n')
+    with pytest.raises(SystemExit, match="corrupt"):
+        cli.main(["doctor", str(bad)])
+
+
+# -- OpenMetrics exposition (acceptance) -------------------------------------
+
+_OM_SAMPLE = __import__("re").compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*(\{le="[^"]+"\})? -?[0-9][0-9eE.+-]*$'
+)
+_OM_TYPE = __import__("re").compile(
+    r"^# TYPE [a-zA-Z_][a-zA-Z0-9_]* (counter|gauge|histogram)$"
+)
+
+
+def _assert_openmetrics_wellformed(text):
+    lines = text.rstrip("\n").splitlines()
+    assert lines[-1] == "# EOF"
+    typed = set()
+    for line in lines[:-1]:
+        if line.startswith("# TYPE"):
+            assert _OM_TYPE.match(line), line
+            typed.add(line.split()[2])
+        else:
+            assert _OM_SAMPLE.match(line), line
+            base = line.split("{")[0].split(" ")[0]
+            stripped = base
+            for suf in ("_total", "_bucket", "_sum", "_count"):
+                if stripped.endswith(suf):
+                    stripped = stripped[: -len(suf)]
+            assert stripped in typed or base in typed, line
+    return lines
+
+
+def test_openmetrics_exposition_parses():
+    r = telemetry.MetricsRegistry()
+    r.counter_inc("backend.dispatches", 3)
+    r.gauge_set("stream.queue_depth", 1)
+    r.gauge_set("stream.queue_depth", 2)
+    r.observe("stage.hash", 1.5e-6)
+    r.observe("stage.hash", 3.0e-6)
+    r.observe("stage.hash", 1.5)
+    text = to_openmetrics(r.snapshot())
+    lines = _assert_openmetrics_wellformed(text)
+    assert "rp_backend_dispatches_total 3" in lines
+    assert "rp_stream_queue_depth 2" in lines
+    assert "rp_stream_queue_depth_max 2" in lines
+    # histogram: cumulative buckets at the fixed log2 upper edges, exact
+    # sum/count riding along
+    assert 'rp_stage_hash_seconds_bucket{le="+Inf"} 3' in lines
+    bucket_lines = [ln for ln in lines if "_bucket{" in ln]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert any(ln.startswith("rp_stage_hash_seconds_sum") for ln in lines)
+    assert "rp_stage_hash_seconds_count 3" in lines
+
+
+def test_openmetrics_merges_stream_registry_via_cli(tmp_path, capsys):
+    """--openmetrics on a workload command writes one exposition carrying
+    BOTH the process registry and the run's StreamStats registry."""
+    from randomprojection_tpu import cli
+
+    X = np.random.default_rng(0).normal(size=(300, 64)).astype(np.float32)
+    xin = str(tmp_path / "x.npy")
+    np.save(xin, X)
+    om = str(tmp_path / "metrics.om")
+    cli.main([
+        "project", "--input", xin, "--output", str(tmp_path / "y.npy"),
+        "--kind", "gaussian", "--n-components", "8",
+        "--backend", "numpy", "--batch-rows", "100",
+        "--openmetrics", om,
+    ])
+    capsys.readouterr()
+    text = open(om).read()
+    lines = _assert_openmetrics_wellformed(text)
+    assert "rp_stream_rows_total 300" in lines  # StreamStats registry
+    assert any(
+        ln.startswith("rp_stage_dispatch_seconds_count") for ln in lines
+    )
+
+
+# -- teardown / unconfigured safety (satellite, subprocess-asserted) ---------
+
+_TEARDOWN_SCRIPT = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from randomprojection_tpu.utils import telemetry
+
+# 1) never configured: everything is a no-op, nothing raises
+telemetry.emit("unconfigured", x=1)
+s = telemetry.start_span("s")
+assert s is None
+telemetry.end_span(s)
+with telemetry.span("t") as t:
+    assert t is None
+
+# 2) configured: leave a span OPEN and schedule emits/spans for
+# interpreter teardown (module-level __del__); the guards must drop
+# them silently — no traceback, no "Exception ignored" noise
+telemetry.configure({path!r})
+telemetry.emit("alive", x=1)
+open_span = telemetry.start_span("left_open", new_trace=True)
+
+class AtTeardown:
+    def __del__(self):
+        telemetry.emit("late.emit")
+        s2 = telemetry.start_span("late_span", new_trace=True)
+        telemetry.end_span(s2)
+        telemetry.end_span(open_span)
+
+keep = AtTeardown()
+print("READY")
+"""
+
+
+def test_emit_and_spans_safe_at_teardown_and_unconfigured(tmp_path):
+    tel = str(tmp_path / "teardown.jsonl")
+    script = _TEARDOWN_SCRIPT.format(repo=str(REPO), path=tel)
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "READY" in proc.stdout
+    assert "Traceback" not in proc.stderr, proc.stderr
+    assert "Exception ignored" not in proc.stderr, proc.stderr
+    # whatever subset of the late events landed, the file must stay
+    # readable end to end (the torn-tail contract)
+    events = [e["event"] for e in read_events(tel)]
+    assert "alive" in events
+
+
+# -- bench trajectory (acceptance) -------------------------------------------
+
+
+def test_bench_trajectory_covers_every_committed_record():
+    from randomprojection_tpu import benchmark
+
+    rows = benchmark.bench_trajectory(str(REPO))
+    files = sorted(
+        p.name for p in REPO.glob("BENCH_r*.json")
+    )
+    assert files, "no committed BENCH_r*.json"
+    assert [r["file"] for r in rows] == files
+    for r in rows:
+        assert "error" in r or r["rates"], r
+
+
+def test_trajectory_table_renders_all_rounds():
+    sys.path.insert(0, str(REPO / "docs"))
+    try:
+        import gen_bench_tables as g
+    finally:
+        sys.path.pop(0)
+    lines = g.render_trajectory()
+    text = "\n".join(lines)
+    for p in sorted(REPO.glob("BENCH_r*.json")):
+        rnd = p.name.replace("BENCH_", "").replace(".json", "")
+        assert f"`{rnd}`" in text
+    # and it is part of the generated BASELINE block
+    block = g.render(g.latest_bench_path())
+    assert "Bench trajectory" in block
