@@ -1,10 +1,13 @@
-# Repo verification entry points (ISSUE r8 satellite).
+# Repo verification entry points (ISSUE r8 satellite; r9 added the
+# staged-ingest leg).
 #
 #   make verify        tier-1 suite (the ROADMAP.md command) + a doctor
 #                      smoke run, so the telemetry/report path cannot rot
 #   make tier1         just the test suite
-#   make doctor-smoke  generate a real telemetry file via the CLI and run
-#                      `doctor` on it (fails if either path breaks)
+#   make doctor-smoke  generate real telemetry files via the CLI (a
+#                      single-worker run AND a staged --ingest-workers
+#                      run) and run `doctor` on them; asserts the staged
+#                      run's report computes a bubble fraction
 
 SHELL := /bin/bash
 PYTHON ?= python
@@ -33,4 +36,16 @@ doctor-smoke:
 	  --openmetrics $(SMOKE_DIR)/metrics.om
 	JAX_PLATFORMS=cpu $(PYTHON) -m randomprojection_tpu doctor $(SMOKE_DIR)/events.jsonl
 	@grep -q '# EOF' $(SMOKE_DIR)/metrics.om || { echo 'openmetrics output missing # EOF'; exit 1; }
+	JAX_PLATFORMS=cpu $(PYTHON) -m randomprojection_tpu project \
+	  --input $(SMOKE_DIR)/x.npy --output $(SMOKE_DIR)/y_staged.npy \
+	  --kind gaussian --n-components 8 --backend numpy --batch-rows 64 \
+	  --ingest-workers 2 \
+	  --telemetry-jsonl $(SMOKE_DIR)/staged.jsonl
+	JAX_PLATFORMS=cpu $(PYTHON) -m randomprojection_tpu doctor \
+	  $(SMOKE_DIR)/staged.jsonl --json | $(PYTHON) -c "import json,sys; \
+	  r = json.load(sys.stdin); \
+	  assert r['traces']['batches'] > 0, 'staged run produced no batch traces'; \
+	  b = r['batch']['bubble']; \
+	  assert isinstance(b.get('pct'), (int, float)), 'no bubble fraction computed'; \
+	  print('staged doctor OK: bubble %.2f%% of batch wall' % b['pct'])"
 	@echo "doctor-smoke OK"

@@ -27,6 +27,7 @@ _LAZY_ESTIMATORS = (
     "SignRandomProjection",
     "CountSketch",
     "SimHashIndex",
+    "TopKServer",
     "pairwise_hamming",
     "pairwise_hamming_device",
     "pairwise_hamming_sharded",

@@ -120,7 +120,9 @@ class JaxBackend(ProjectionBackend):
         self._transform_fn = None
         self._inverse_fn = None
         self._sign_fn = None
+        self._sign_fn_raw = None
         self._pack_fn = None
+        self._pack_fn_raw = None
         self._split_fn = None
         self._slice_fns = {}
         self._lazy_mesh_fns = {}
@@ -136,25 +138,40 @@ class JaxBackend(ProjectionBackend):
     def _replicated_sharding(self):
         """Layout for R: replicated under pure DP; column-sharded over the
         feature axis under TP (each chip holds R[:, d_shard] — SURVEY.md
-        §3.3; XLA then completes the contraction with one psum over ICI)."""
+        §3.3; XLA then completes the contraction with one psum over ICI).
+        Built once and cached: mesh/axes are fixed at construction, and
+        this sits on the per-batch dispatch path (ISSUE r9 satellite —
+        invariant work happens once, not per batch)."""
         if self.mesh is None:
             return None
-        from jax.sharding import NamedSharding, PartitionSpec
+        sh = self.__dict__.get("_replicated_sharding_cache")
+        if sh is None:
+            from jax.sharding import NamedSharding, PartitionSpec
 
-        if self.feature_axis is not None:
-            return NamedSharding(self.mesh, PartitionSpec(None, self.feature_axis))
-        return NamedSharding(self.mesh, PartitionSpec())
+            if self.feature_axis is not None:
+                sh = NamedSharding(
+                    self.mesh, PartitionSpec(None, self.feature_axis)
+                )
+            else:
+                sh = NamedSharding(self.mesh, PartitionSpec())
+            self.__dict__["_replicated_sharding_cache"] = sh
+        return sh
 
     def _row_sharding(self):
         """Layout for X batches: rows over 'data', features over the TP axis
-        when configured."""
+        when configured.  Cached like ``_replicated_sharding`` — called
+        once per streamed batch."""
         if self.mesh is None:
             return None
-        from jax.sharding import NamedSharding, PartitionSpec
+        sh = self.__dict__.get("_row_sharding_cache")
+        if sh is None:
+            from jax.sharding import NamedSharding, PartitionSpec
 
-        return NamedSharding(
-            self.mesh, PartitionSpec(self.data_axis, self.feature_axis)
-        )
+            sh = NamedSharding(
+                self.mesh, PartitionSpec(self.data_axis, self.feature_axis)
+            )
+            self.__dict__["_row_sharding_cache"] = sh
+        return sh
 
     # -- ProjectionBackend API ----------------------------------------------
 
@@ -646,7 +663,6 @@ class JaxBackend(ProjectionBackend):
         if self._sign_fn is None:
             precision = self._einsum_precision()
 
-            @jax.jit
             def _sign_project(x, r):
                 y = jnp.einsum(
                     "nd,kd->nk", x, r,
@@ -654,24 +670,43 @@ class JaxBackend(ProjectionBackend):
                 )
                 return jnp.packbits(y > 0, axis=-1, bitorder="little")
 
-            self._sign_fn = _sign_project
+            # keep the raw body alongside the jitted wrapper: when this
+            # path is invoked INSIDE an outer trace (a jitted serving
+            # loop or harness), calling the raw body inlines the
+            # einsum+packbits into the caller's program — a nested-pjit
+            # call boundary would survive into the jaxpr and fence XLA
+            # fusion with the surrounding computation (the r05
+            # estimator_vs_raw = 0.83 gap's structural suspect)
+            self._sign_fn_raw = _sign_project
+            self._sign_fn = jax.jit(_sign_project)
 
         if isinstance(state, (_LazyMask, _SplitMask)):
             # lazy/split paths: compute coordinates, then pack on device
             y_coords, device_resident = self._transform_impl(X, state, spec)
             if self._pack_fn is None:
-                self._pack_fn = jax.jit(
-                    lambda a: jnp.packbits(a > 0, axis=-1, bitorder="little")
+                self._pack_fn_raw = lambda a: jnp.packbits(
+                    a > 0, axis=-1, bitorder="little"
                 )
-            y = self._pack_fn(y_coords)
+                self._pack_fn = jax.jit(self._pack_fn_raw)
+            pack = (
+                self._pack_fn_raw
+                if isinstance(y_coords, jax.core.Tracer)
+                else self._pack_fn
+            )
+            y = pack(y_coords)
         else:
             from randomprojection_tpu.utils.observability import annotate
 
             x, n, device_resident = self._prepare_rows(
                 X, allow_bf16=spec.dtype == "bfloat16"
             )
+            fn = (
+                self._sign_fn_raw
+                if isinstance(x, jax.core.Tracer)
+                else self._sign_fn
+            )
             with annotate("rp:backend/sign_project"):
-                y = self._slice_rows(self._sign_fn(x, state), n)
+                y = self._slice_rows(fn(x, state), n)
         if device_resident or not materialize:
             return y
         return np.asarray(y)

@@ -9,6 +9,8 @@ SURVEY.md §6 config/flag system):
 - ``bench``         the north-star data-resident metric (JSON line)
 - ``stream-bench``  host-streamed throughput (the PCIe-bound number;
                     kept separate per SURVEY.md §7)
+- ``topk-bench``    SimHash top-k serving queries/s, direct vs the
+                    ``TopKServer`` micro-batcher
 - ``doctor``        per-batch critical-path report from a telemetry
                     JSONL file (alias: ``report``) — stage waterfall,
                     bubbles, degraded-event audit, tripwire status
@@ -32,6 +34,15 @@ def _add_common(p):
                         "reads) and early H2D upload on a background "
                         "worker thread, keeping up to this many batches "
                         "queued ahead of the consumer (0 = synchronous)")
+    p.add_argument("--ingest-workers", type=int, default=0,
+                   help="staged multi-worker ingest: a pool of this many "
+                        "hash workers producing disjoint batches "
+                        "(reassembled in row order, bit-identical to "
+                        "serial) feeding a dedicated prep/H2D uploader "
+                        "stage; 0/1 = single-worker (see "
+                        "--prefetch-batches).  The queue depth between "
+                        "the uploader and the consumer is "
+                        "--prefetch-batches (default 2 when staged)")
     p.add_argument("--hash-threads", type=int, default=None,
                    help="worker threads for the C++ murmur3 batch hasher "
                         "(sets RP_HASH_THREADS; output is bit-identical "
@@ -155,6 +166,34 @@ def build_parser():
                    help="print the report as one JSON object instead of "
                         "the rendered text")
 
+    q = sub.add_parser(
+        "topk-bench",
+        help="SimHash top-k serving throughput (direct vs micro-batched)",
+        description="Build a random SimHashIndex and measure query_topk "
+                    "queries/s two ways: direct per-request calls, and "
+                    "through the TopKServer micro-batcher that coalesces "
+                    "concurrent requests into one tile dispatch.",
+    )
+    q.add_argument("--index-codes", type=_positive_int, default=1 << 18,
+                   help="rows in the resident code index")
+    q.add_argument("--code-bytes", type=_positive_int, default=32,
+                   help="packed code width (bytes/row; 32 = 256 bits)")
+    q.add_argument("--m", type=_positive_int, default=16,
+                   help="neighbors per query")
+    q.add_argument("--queries", type=_positive_int, default=4096,
+                   help="total queries per measurement")
+    q.add_argument("--request-rows", type=_positive_int, default=64,
+                   help="query rows per client request")
+    q.add_argument("--clients", type=_positive_int, default=8,
+                   help="concurrent client threads for the server mode")
+    q.add_argument("--server-batch", type=_positive_int, default=8192,
+                   help="TopKServer max coalesced rows per dispatch")
+    q.add_argument("--server-delay-ms", type=float, default=2.0,
+                   help="TopKServer max wait for stragglers once a "
+                        "request is in hand")
+    q.add_argument("--seed", type=int, default=0)
+    _add_observability(q)
+
     q = sub.add_parser("stream-bench", help="host-streamed throughput")
     q.add_argument("--rows", type=int, default=262144)
     q.add_argument("--d", type=int, default=4096)
@@ -234,10 +273,20 @@ def _make_estimator(args):
 
 
 def _wrap_prefetch(source, est, args, stats):
-    """Wrap ``source`` in a ``PrefetchSource`` when ``--prefetch-batches``
-    asks for one: production (and the estimator's early-H2D
-    ``prepare_batch``) moves to a background worker thread."""
+    """Wrap ``source`` in the requested ingest pipeline: a staged
+    multi-worker pool (``--ingest-workers >= 2``) or a single prefetch
+    worker (``--prefetch-batches``); production (and the estimator's
+    early-H2D ``prepare_batch``) moves off the consumer thread either
+    way."""
     depth = getattr(args, "prefetch_batches", 0)
+    workers = getattr(args, "ingest_workers", 0)
+    if workers >= 2:
+        from randomprojection_tpu.streaming import StagedIngestSource
+
+        return StagedIngestSource(
+            source, workers=workers, depth=depth or 2,
+            prepare=est.prepare_batch, stats=stats,
+        )
     if not depth:
         return source
     from randomprojection_tpu.streaming import PrefetchSource
@@ -436,6 +485,85 @@ def cmd_bench(args):
                           density=args.density))
 
 
+def cmd_topk_bench(args):
+    """Top-k serving throughput, direct vs micro-batched (the r9 serving
+    path): the direct mode issues one ``query_topk`` per ``request-rows``
+    request back-to-back; the server mode has ``--clients`` threads
+    submit the same requests concurrently through a ``TopKServer``,
+    which coalesces them into ``--server-batch``-row tile dispatches.
+    Query values are distinct per request (sliced from one pregenerated
+    pool) so this box's device call cache cannot serve repeats."""
+    import threading
+    import time
+
+    from randomprojection_tpu.models.sketch import SimHashIndex, TopKServer
+
+    rng = np.random.default_rng(args.seed)
+    codes = rng.integers(
+        0, 256, size=(args.index_codes, args.code_bytes), dtype=np.uint8
+    )
+    n_requests = -(-args.queries // args.request_rows)
+    pool = rng.integers(
+        0, 256, size=(n_requests * args.request_rows, args.code_bytes),
+        dtype=np.uint8,
+    )
+    requests = [
+        pool[i * args.request_rows : (i + 1) * args.request_rows]
+        for i in range(n_requests)
+    ]
+    index = SimHashIndex(codes)
+    index.query_topk(requests[0], args.m)  # warm compile
+
+    t0 = time.perf_counter()
+    for req in requests:
+        index.query_topk(req, args.m)
+    direct_elapsed = time.perf_counter() - t0
+    direct_qps = len(requests) * args.request_rows / direct_elapsed
+
+    server = TopKServer(
+        index, args.m, max_batch=args.server_batch,
+        max_delay_s=args.server_delay_ms / 1e3,
+    )
+    server.query(requests[0])  # warm the coalesced-bucket compile
+
+    def client(reqs, out):
+        futs = [server.submit(r) for r in reqs]
+        out.extend(f.result() for f in futs)
+
+    per_client = [requests[i :: args.clients] for i in range(args.clients)]
+    results: list = [[] for _ in range(args.clients)]
+    threads = [
+        threading.Thread(target=client, args=(per_client[i], results[i]))
+        for i in range(args.clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server_elapsed = time.perf_counter() - t0
+    server.close()
+    server_qps = len(requests) * args.request_rows / server_elapsed
+
+    print(json.dumps({
+        "metric": f"simhash top-k serving queries/s (m={args.m}, "
+                  f"{args.index_codes} codes)",
+        "index_codes": args.index_codes,
+        "code_bytes": args.code_bytes,
+        "m": args.m,
+        "request_rows": args.request_rows,
+        "requests": len(requests),
+        "clients": args.clients,
+        "direct_queries_per_s": round(direct_qps, 1),
+        "server_queries_per_s": round(server_qps, 1),
+        "server_speedup": round(server_qps / direct_qps, 2),
+        "server_batch": args.server_batch,
+        "server_delay_ms": args.server_delay_ms,
+        **{f"server_{k}": v for k, v in server.stats().items()},
+    }))
+    _write_openmetrics(args)
+
+
 def cmd_stream_bench(args):
     """Host-streamed rows/s: includes h2d (PCIe) — the honest streamed
     number, which SURVEY.md §7 R3 predicts is transfer-bound.  The
@@ -507,6 +635,7 @@ def cmd_stream_bench(args):
         "bytes_in": stats.bytes_in,
         "elapsed_s": round(elapsed, 4),
         "prefetch_batches": args.prefetch_batches,
+        "ingest_workers": args.ingest_workers,
     }
     if stats.stage_wall:
         out["stage_wall_s"] = {
@@ -525,6 +654,10 @@ def main(argv=None):
     if getattr(args, "prefetch_batches", 0) < 0:
         raise SystemExit(
             f"--prefetch-batches must be >= 0, got {args.prefetch_batches}"
+        )
+    if getattr(args, "ingest_workers", 0) < 0:
+        raise SystemExit(
+            f"--ingest-workers must be >= 0, got {args.ingest_workers}"
         )
     if getattr(args, "hash_threads", None) is not None:
         if args.hash_threads < 1:
@@ -561,6 +694,7 @@ def main(argv=None):
         "project": cmd_project,
         "bench": cmd_bench,
         "stream-bench": cmd_stream_bench,
+        "topk-bench": cmd_topk_bench,
         "doctor": cmd_doctor,
         "report": cmd_doctor,  # alias
     }[args.cmd](args)

@@ -14,6 +14,7 @@ from randomprojection_tpu.models.sketch import (
     CountSketch,
     SimHashIndex,
     SignRandomProjection,
+    TopKServer,
     cosine_from_hamming,
     pairwise_hamming,
     pairwise_hamming_device,
@@ -28,6 +29,7 @@ __all__ = [
     "SignRandomProjection",
     "CountSketch",
     "SimHashIndex",
+    "TopKServer",
     "pairwise_hamming",
     "pairwise_hamming_device",
     "pairwise_hamming_sharded",

@@ -30,6 +30,12 @@ SURVEY.md §2 L2, §4.5).  TPU-native design:
   after the consumer has processed the yielded batch (ack-after-yield),
   and a resume recomputes any batch that was prefetched but never
   consumed.
+- **Staged multi-worker ingest** (r9): ``StagedIngestSource`` splits the
+  single prefetch worker into a POOL of hash workers (disjoint batches,
+  reassembled in row order — bit-identical to serial) feeding a dedicated
+  prep/H2D uploader stage through bounded queues; the cursor contract,
+  deterministic shutdown and trace-root propagation hold across every
+  stage boundary.  CLI: ``--ingest-workers N``.
 - **Per-batch tracing** (r8): when a telemetry sink is configured
   (``--telemetry-jsonl``), every batch carries one trace — a root span
   created where production starts (the prefetch worker, for an
@@ -43,6 +49,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import numbers
 import os
 import queue
 import threading
@@ -65,12 +72,36 @@ __all__ = [
     "FaultInjectionSource",
     "TokenSource",
     "PrefetchSource",
+    "StagedIngestSource",
     "StreamCursor",
     "iter_traced",
     "stream_transform",
     "stream_to_array",
     "stream_to_memmap",
 ]
+
+
+def _batch_rows(batch, default: Optional[int] = None) -> Optional[int]:
+    """Row count of one in-flight batch, tolerant of prepared operands.
+
+    ``prepare_batch`` hooks may replace the raw batch with a device-side
+    carrier (``models.sketch.DeviceBatch`` mirrors ``.shape``; future
+    carriers may not, or may expose a 0-d / symbolic shape) — a bare
+    ``batch.shape[0]`` then crashes the stream or, worse, records a wrong
+    row count into telemetry the doctor treats as truth.  Resolution
+    order: a real leading ``shape`` dimension, then a ``DeviceBatch``-
+    style integral ``.n``, then ``default``.
+    """
+    shape = getattr(batch, "shape", None)
+    if shape is not None:
+        try:
+            return int(shape[0])
+        except (TypeError, IndexError, ValueError):
+            pass
+    n = getattr(batch, "n", None)
+    if isinstance(n, numbers.Integral):
+        return int(n)
+    return default
 
 
 def iter_traced(source, start_row: int = 0):
@@ -274,10 +305,17 @@ class TokenSource(RowBatchSource):
 
 
 class FaultInjectionSource(RowBatchSource):
-    """Test wrapper: raises after yielding ``fail_after_batches`` batches.
+    """Test wrapper: raises at the ``fail_after_batches``-th GLOBAL batch.
 
     The SURVEY.md §6 fault-injection harness: crash a stream mid-flight,
     resume from the checkpoint cursor, assert bit-identical output.
+
+    The fault fires on the batch's global index (``lo // batch_rows``),
+    not on a per-iterator yield count: a staged ingest pool opens one
+    short iteration per batch (``StagedIngestSource``), so counting yields
+    per iterator would never reach the threshold there.  For a full serial
+    pass from row 0 — every shipped armed usage — the two rules pick the
+    identical batch.
     """
 
     class InjectedFault(RuntimeError):
@@ -296,10 +334,11 @@ class FaultInjectionSource(RowBatchSource):
         self._armed = False
 
     def iter_batches(self, start_row: int = 0):
-        for i, (lo, batch) in enumerate(self._inner.iter_batches(start_row)):
-            if self._armed and i >= self.fail_after_batches:
+        for lo, batch in self._inner.iter_batches(start_row):
+            if self._armed and lo // self.batch_rows >= self.fail_after_batches:
                 raise self.InjectedFault(
-                    f"injected fault before batch {i} (row {lo})"
+                    f"injected fault before batch {lo // self.batch_rows} "
+                    f"(row {lo})"
                 )
             yield lo, batch
 
@@ -494,6 +533,291 @@ class PrefetchSource(RowBatchSource):
                 telemetry.emit("stream.prefetch.shutdown_timeout")
 
 
+class StagedIngestSource(RowBatchSource):
+    """Staged multi-worker ingest: a POOL of hash workers producing
+    disjoint batches, reassembled in row order, feeding a dedicated
+    prep/H2D uploader stage through bounded queues.
+
+    ``PrefetchSource`` (r6) moved production off the consumer thread but
+    kept it on ONE worker: hash, CSR build and the prepare/H2D step all
+    serialize there, so the pipeline tops out at that single thread's
+    rate (r05: ~22% of the slowest stage's cap).  This source splits the
+    pipeline into stages:
+
+    - **hash pool** — ``workers`` threads; worker ``w`` owns batch
+      indices ``w, w+N, w+2N, …`` and produces each by seeking the inner
+      source (``iter_batches(lo)``, first batch only).  Output is
+      **bit-identical to serial** because every shipped source is a pure
+      function of its row range ``(lo, hi)`` — the same determinism the
+      cursor-resume contract already requires.  ``TokenSource`` workers
+      reuse the ``hash_threads`` murmur3 machinery one level up: each
+      worker hashes its own batches (pin ``hash_threads=1`` per worker
+      and let the pool supply the parallelism — or combine both knobs).
+    - **uploader** — one thread reassembling the workers' outputs in
+      batch order (worker queues are drained round-robin by index, so
+      ordering is deterministic, not racy) and running the optional
+      ``prepare`` step (early H2D) before delivering into the final
+      bounded queue the consumer drains.
+
+    Contract (same as ``PrefetchSource``, held across every stage
+    boundary):
+
+    - **Ordering**: batches reach the consumer in row order.
+    - **Cursor safety**: the pool advances only *production*; commit
+      stays with the consumer's ack-after-yield in ``stream_transform``.
+      Batches produced ahead but never consumed are recomputed on
+      resume.
+    - **Exception propagation**: a failure producing (or preparing)
+      batch ``i`` reaches the consumer *after* batches ``0..i-1`` — the
+      serial prefix-then-raise behavior, so fault-injection/resume
+      semantics are unchanged.
+    - **Deterministic shutdown**: closing the generator (``break``,
+      exception, GC) stops and joins every stage thread; queued-ahead
+      batches close their traces as ``abandoned``.
+    - **Tracing**: each batch's trace root is created on the hash worker
+      that produces it (r8 protocol), travels through both queues, and
+      is ended by the consumer at commit — ``h2d`` (uploader) and
+      ``dispatch``/``d2h`` (consumer) spans join it across threads.
+
+    The inner source must be seekable, deterministic in ``(lo, hi)``
+    and safe for **concurrent** iteration from multiple threads (all
+    shipped sources are; a custom ``CallableSource``/``TokenSource``
+    reader must not share unsynchronized mutable state).  Host memory is
+    bounded by ``~2·workers + depth + 1`` produced batches.
+    """
+
+    _DONE = object()
+    _POLL_S = 0.05  # stop-aware put/get poll (see PrefetchSource)
+
+    def __init__(self, inner: RowBatchSource, *, workers: int = 2,
+                 depth: int = 2, prepare: Optional[Callable] = None,
+                 stats=None):
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._inner = inner
+        self.workers = workers
+        self.depth = depth
+        self.prepare = prepare
+        self.stats = stats
+        self.batch_rows = inner.batch_rows
+        self.n_rows = inner.n_rows
+        self.n_features = inner.n_features
+        self.dtype = inner.dtype
+
+    def iter_batches(self, start_row: int = 0):
+        it = self.iter_batches_traced(start_row)
+        try:
+            for lo, batch, root in it:
+                try:
+                    yield lo, batch
+                finally:
+                    telemetry.end_span(root, row=int(lo))
+        finally:
+            it.close()
+
+    def _produce_one(self, lo: int):
+        """Produce the single batch starting at ``lo`` with its trace
+        root opened on THIS (worker) thread, so the inner source's
+        instrumented stages (TokenSource's hash) parent correctly."""
+        root = telemetry.start_span("batch", new_trace=True)
+        try:
+            with telemetry.activate_span(root):
+                it = self._inner.iter_batches(lo)
+                try:
+                    try:
+                        got_lo, batch = next(it)
+                    except StopIteration:
+                        raise RuntimeError(
+                            f"inner source yielded no batch at row {lo}"
+                        ) from None
+                finally:
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
+            if got_lo != lo:
+                raise RuntimeError(
+                    f"inner source yielded row {got_lo}, expected {lo} "
+                    "(seekable-source contract violation)"
+                )
+        except BaseException:
+            telemetry.end_span(root, error=True)
+            raise
+        return batch, root
+
+    def iter_batches_traced(self, start_row: int = 0):
+        """``iter_traced`` face: ``(lo, batch, trace_root)`` in row order.
+        The caller owns ending each root (``stream_transform`` ends them
+        at commit)."""
+        _check_start_row(start_row, self.batch_rows, self.n_rows)
+        remaining = max(self.n_rows - start_row, 0)
+        n_batches = -(-remaining // self.batch_rows) if remaining else 0
+        n_workers = max(1, min(self.workers, n_batches or 1))
+        # worker queues are tiny (each worker runs at most ~2 batches
+        # ahead); the final queue carries the consumer-facing depth
+        worker_qs = [queue.Queue(maxsize=1) for _ in range(n_workers)]
+        out_q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def _put(q: queue.Queue, item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=self._POLL_S)
+                    return True
+                except queue.Full:
+                    pass
+            return False
+
+        def _get(q: queue.Queue, producer: threading.Thread):
+            """Stop-aware get that notices a dead producer; None means a
+            shutdown was requested."""
+            while not stop.is_set():
+                try:
+                    return q.get(timeout=self._POLL_S)
+                except queue.Empty:
+                    if producer.is_alive():
+                        continue
+                    try:  # it may have posted right before exiting
+                        return q.get_nowait()
+                    except queue.Empty:
+                        raise RuntimeError(
+                            f"staged {producer.name} died without a result"
+                        ) from None
+            return None
+
+        def hash_work(w: int):
+            try:
+                for i in range(w, n_batches, n_workers):
+                    lo = start_row + i * self.batch_rows
+                    batch, root = self._produce_one(lo)
+                    if not _put(worker_qs[w], (i, lo, batch, root)):
+                        # consumer went away mid-delivery
+                        telemetry.end_span(root, row=int(lo), abandoned=True)
+                        return
+            except BaseException as e:
+                telemetry.emit(
+                    "stream.staged.error", stage="hash", worker=w,
+                    error=repr(e),
+                )
+                _put(worker_qs[w], (self._DONE, e))
+
+        hash_threads = [
+            threading.Thread(
+                target=hash_work, args=(w,),
+                name=f"rp-staged-hash-{w}", daemon=True,
+            )
+            for w in range(n_workers)
+        ]
+
+        def upload_work():
+            try:
+                for i in range(n_batches):
+                    item = _get(worker_qs[i % n_workers],
+                                hash_threads[i % n_workers])
+                    if item is None:  # shutdown requested
+                        return
+                    if isinstance(item, tuple) and item[0] is self._DONE:
+                        # worker failure at this batch index: forward it
+                        # AFTER the in-order prefix already delivered —
+                        # the serial prefix-then-raise behavior (the
+                        # worker emitted the staged.error event)
+                        _put(out_q, (self._DONE, item[1]))
+                        return
+                    _i, lo, batch, root = item
+                    try:
+                        if self.prepare is not None:
+                            with telemetry.activate_span(root), \
+                                    _stage(self.stats, "h2d"):
+                                batch = self.prepare(batch)
+                    except BaseException:
+                        telemetry.end_span(root, row=int(lo), error=True)
+                        raise
+                    depth_now = out_q.qsize()
+                    if self.stats is not None:
+                        self.stats.on_queue_depth(depth_now)
+                    telemetry.emit(
+                        "stream.staged.deliver", row=int(lo),
+                        queue_depth=int(depth_now), capacity=self.depth,
+                        workers=n_workers,
+                        **(
+                            {"trace_id": root.trace_id}
+                            if root is not None else {}
+                        ),
+                    )
+                    with telemetry.span(
+                        "enqueue_wait", parent=root, require_parent=True,
+                    ):
+                        delivered = _put(out_q, (lo, batch, root))
+                    if not delivered:
+                        telemetry.end_span(root, row=int(lo), abandoned=True)
+                        return
+                _put(out_q, self._DONE)
+            except BaseException as e:
+                telemetry.emit(
+                    "stream.staged.error", stage="upload", error=repr(e)
+                )
+                _put(out_q, (self._DONE, e))
+
+        uploader = threading.Thread(
+            target=upload_work, name="rp-staged-upload", daemon=True
+        )
+        for t in hash_threads:
+            t.start()
+        uploader.start()
+        all_threads = (*hash_threads, uploader)
+        try:
+            while True:
+                try:
+                    item = out_q.get(timeout=self._POLL_S)
+                except queue.Empty:
+                    if uploader.is_alive():
+                        continue
+                    try:
+                        item = out_q.get_nowait()
+                    except queue.Empty:
+                        raise RuntimeError(
+                            "staged upload worker died without a result"
+                        ) from None
+                if item is self._DONE:
+                    return
+                if isinstance(item, tuple) and item[0] is self._DONE:
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()
+            for t in all_threads:
+                # bounded join, same rationale as PrefetchSource: a
+                # worker stuck in a hung read/prepare never reaches the
+                # stop-aware _put and must not hang the consumer
+                t.join(timeout=5.0)
+            # close the traces of batches produced but never handed to
+            # the consumer — a clean break leaves no orphan spans
+            for q in (*worker_qs, out_q):
+                while True:
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if isinstance(item, tuple) and len(item) == 4:
+                        telemetry.end_span(
+                            item[3], row=int(item[1]), abandoned=True
+                        )
+                    elif isinstance(item, tuple) and len(item) == 3:
+                        telemetry.end_span(
+                            item[2], row=int(item[0]), abandoned=True
+                        )
+            if any(t.is_alive() for t in all_threads):  # pragma: no cover
+                from randomprojection_tpu.utils.observability import logger
+
+                logger.warning(
+                    "staged ingest worker(s) did not stop within 5s of "
+                    "shutdown (inner source read or prepare() appears "
+                    "hung); abandoning the daemon thread(s)"
+                )
+                telemetry.emit("stream.staged.shutdown_timeout")
+
+
 @dataclasses.dataclass
 class StreamCursor:
     """Resumable position in a stream; serializes to a tiny JSON file.
@@ -614,9 +938,26 @@ def stream_transform(
             with telemetry.activate_span(root), \
                     annotate("rp:stream/dispatch"), _stage(stats, "dispatch"):
                 y = estimator._transform_async(batch)
+                # row count survives prepared operands without a plain
+                # .shape (DeviceBatch carries .n; last resort is the
+                # output handle, whose leading dim IS the batch's rows).
+                # The count feeds the CURSOR as well as telemetry, so
+                # undeterminable rows must fail loudly here — a defaulted
+                # 0 would silently freeze rows_done and make every resume
+                # recompute (or re-append) already-consumed batches
+                n_rows = _batch_rows(batch)
+                if n_rows is None:
+                    n_rows = _batch_rows(y)
+                if n_rows is None:
+                    raise TypeError(
+                        f"cannot determine the row count of batch "
+                        f"{type(batch).__name__!r} (no usable .shape or "
+                        f".n) or its transform output "
+                        f"{type(y).__name__!r}; prepared batch carriers "
+                        "must expose one or the other"
+                    )
                 telemetry.emit(
-                    "stream.dispatch", row=int(start_row),
-                    rows=int(getattr(batch, "shape", (0,))[0]),
+                    "stream.dispatch", row=int(start_row), rows=int(n_rows),
                     **telemetry.trace_fields(),
                 )
             fetch_async = getattr(y, "copy_to_host_async", None)
@@ -630,7 +971,7 @@ def stream_transform(
             # keep only the byte count: retaining the batch itself would pin
             # pipeline_depth extra input batches of host memory
             pending.append(
-                (start_row, batch.shape[0], y, batch_nbytes(batch), root)
+                (start_row, n_rows, y, batch_nbytes(batch), root)
             )
             if len(pending) >= pipeline_depth:
                 yield from emit(pending.pop(0))

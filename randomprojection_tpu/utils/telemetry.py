@@ -85,6 +85,7 @@ __all__ = [
     "configure",
     "shutdown",
     "enabled",
+    "active_path",
     "emit",
     "parse_event",
     "read_events",
@@ -368,6 +369,14 @@ def enabled() -> bool:
     """True when a process-wide sink is installed.  Hot paths with
     non-trivial payload construction should guard on this."""
     return _ACTIVE_LOG is not None
+
+
+def active_path() -> Optional[str]:
+    """Path of the installed sink (None when telemetry is off) — lets a
+    caller that needs a scoped sink (the bench's staged-ingest trace
+    capture) restore the user's sink afterwards."""
+    log = _ACTIVE_LOG
+    return log.path if log is not None else None
 
 
 def _finalizing() -> bool:

@@ -18,7 +18,8 @@ and where are the pipeline bubbles?**
 - **pipeline overlap** — run elapsed (span of all batch traces) vs the
   summed stage wall, the same ``1 - elapsed/Σ`` shape as
   ``StreamStats.pipeline_overlap_ratio``.
-- **queue-depth-over-time** — from ``stream.prefetch.deliver`` samples.
+- **queue-depth-over-time** — from ``stream.prefetch.deliver`` /
+  ``stream.staged.deliver`` samples.
 - **degraded-event audit** — VMEM-OOM retries, dense fallbacks, top-k
   block clamps, python-path hash batches, prefetch errors.
 
@@ -50,6 +51,8 @@ DEGRADED_EVENTS = (
     "simhash.topk_block_clamp",
     "stream.prefetch.error",
     "stream.prefetch.shutdown_timeout",
+    "stream.staged.error",
+    "stream.staged.shutdown_timeout",
 )
 
 
@@ -176,7 +179,7 @@ def build_report(path: str) -> dict:
                 stage_total[k] = stage_total.get(k, 0.0) + v
             bubble_total += bubble
             wall_total += wall
-        elif name == "stream.prefetch.deliver":
+        elif name in ("stream.prefetch.deliver", "stream.staged.deliver"):
             d = e.get("queue_depth", 0)
             queue_n += 1
             queue_max = max(queue_max, d)

@@ -414,3 +414,149 @@ def test_query_topk_dense_fallback_when_key_overflows(monkeypatch):
     brute_d, brute_i = sk.topk_bruteforce(A, B, 5)
     np.testing.assert_array_equal(got_d, brute_d)
     np.testing.assert_array_equal(got_i, brute_i)
+
+
+# ---------------------------------------------------------------------------
+# top-k serving: overlapped d2h + TopKServer micro-batcher (ISSUE r9)
+# ---------------------------------------------------------------------------
+
+
+def _serving_fixture(n_codes=5000, n_add=300, nq=1000, nb=8, seed=0):
+    from randomprojection_tpu.models.sketch import SimHashIndex
+
+    rng = np.random.default_rng(seed)
+    idx = SimHashIndex(rng.integers(0, 256, size=(n_codes, nb), dtype=np.uint8))
+    if n_add:
+        idx.add(rng.integers(0, 256, size=(n_add, nb), dtype=np.uint8))
+    q = rng.integers(0, 256, size=(nq, nb), dtype=np.uint8)
+    return idx, q
+
+
+def test_query_topk_multi_tile_overlap_matches_bruteforce():
+    """The overlapped d2h restructure (per-chunk copy_to_host_async,
+    tiles materializing one behind) must not change a single result —
+    multi-tile, multi-chunk, against the host brute-force oracle."""
+    from randomprojection_tpu.models import sketch as sk
+
+    idx, q = _serving_fixture()
+    full = np.concatenate([np.asarray(c.b)[: c.n] for c in idx._chunks])
+    d, i = idx.query_topk(q, 5, tile=128)  # 8 tiles x 2 chunks in flight
+    ref_d, ref_i = sk.topk_bruteforce(q, full, 5)
+    np.testing.assert_array_equal(d, ref_d)
+    np.testing.assert_array_equal(i, ref_i)
+    # the dense path's tile overlap too
+    np.testing.assert_array_equal(
+        idx.query(q, tile=128), sk.pairwise_hamming(q, full)
+    )
+
+
+def test_topk_server_matches_direct_and_coalesces():
+    """Concurrent mixed-size requests through the server must return the
+    identical (dist, idx) a direct query_topk gives, in request row
+    order — while coalescing many requests into few dispatches."""
+    from randomprojection_tpu.models.sketch import TopKServer
+
+    idx, q = _serving_fixture()
+    ref_d, ref_i = idx.query_topk(q, 5)
+    with TopKServer(idx, 5, max_batch=256, max_delay_s=0.005) as srv:
+        futs, off = [], 0
+        for size in [1, 7, 64, 3, 128, 33] * 4:
+            futs.append((off, size, srv.submit(q[off : off + size])))
+            off += size
+        for o, s, f in futs:
+            d, i = f.result(timeout=60)
+            assert d.shape == i.shape == (s, 5)
+            np.testing.assert_array_equal(d, ref_d[o : o + s])
+            np.testing.assert_array_equal(i, ref_i[o : o + s])
+        st = srv.stats()
+        assert st["requests"] == 24
+        assert st["batches"] < st["requests"], "requests must coalesce"
+        assert st["queries"] == off
+        # 1-D convenience: one row in, (1, m) out
+        d1, i1 = srv.query(q[0])
+        np.testing.assert_array_equal(d1, ref_d[:1])
+        np.testing.assert_array_equal(i1, ref_i[:1])
+
+
+def test_topk_server_threaded_clients_bit_identical():
+    from randomprojection_tpu.models.sketch import TopKServer
+    import threading
+
+    idx, q = _serving_fixture(nq=960)
+    ref_d, ref_i = idx.query_topk(q, 3)
+    out = {}
+    with TopKServer(idx, 3, max_batch=512, max_delay_s=0.01) as srv:
+        def client(ci):
+            futs = [
+                (o, srv.submit(q[o : o + 32]))
+                for o in range(ci * 240, (ci + 1) * 240, 32)
+            ]
+            out[ci] = [(o, f.result(timeout=60)) for o, f in futs]
+
+        threads = [
+            threading.Thread(target=client, args=(ci,)) for ci in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for ci in range(4):
+        for o, (d, i) in out[ci]:
+            np.testing.assert_array_equal(d, ref_d[o : o + 32])
+            np.testing.assert_array_equal(i, ref_i[o : o + 32])
+
+
+def test_topk_server_lifecycle_and_validation():
+    import threading
+
+    from randomprojection_tpu.models.sketch import TopKServer
+
+    idx, q = _serving_fixture(n_codes=200, n_add=0, nq=8)
+    with pytest.raises(ValueError, match="m must be"):
+        TopKServer(idx, 0)
+    with pytest.raises(ValueError, match="max_batch"):
+        TopKServer(idx, 2, max_batch=0)
+    with pytest.raises(ValueError, match="max_delay_s"):
+        TopKServer(idx, 2, max_delay_s=-1)
+    srv = TopKServer(idx, 2, max_delay_s=0.0)
+    with pytest.raises(ValueError, match="queries must be"):
+        srv.submit(np.zeros((2, 3), np.uint8))  # wrong code width
+    with pytest.raises(ValueError, match="empty request"):
+        srv.submit(np.zeros((0, 8), np.uint8))
+    # close serves already-submitted requests, then refuses new ones
+    fut = srv.submit(q[:4])
+    srv.close()
+    d, i = fut.result(timeout=60)
+    assert d.shape == (4, 2)
+    with pytest.raises(RuntimeError, match="closed"):
+        srv.submit(q[:1])
+    srv.close()  # idempotent
+    assert not [
+        t for t in threading.enumerate() if t.name.startswith("rp-topk")
+    ]
+
+
+def test_topk_bench_composition(monkeypatch):
+    """The config-4 serving bench (single-stream + micro-batched modes)
+    runs end to end at toy shapes and records both rates with their own
+    suspect flags."""
+    from randomprojection_tpu import benchmark
+
+    monkeypatch.setitem(
+        benchmark.TOPK_BENCH_SHAPES, "smoke",
+        dict(n_idx=2048, q_tile=128, clients=2, req_rows=16,
+             reqs_per_client=2, max_batch=64),
+    )
+    tk = benchmark.measure_config4_topk("smoke")
+    assert tk["queries_per_s"] > 0
+    assert tk["single_stream_queries_per_s"] > 0
+    assert tk["index_codes"] == 2048
+    assert isinstance(tk["timing_suspect"], bool)
+    assert isinstance(tk["single_stream_timing_suspect"], bool)
+    assert tk["server_rows_per_batch_mean"] > 0
+    # both rates feed the regression tripwire under their own flags
+    rates = benchmark.bench_rates({"config4": {"topk_serving": tk}})
+    assert rates["config4.topk.queries_per_s"][0] == tk["queries_per_s"]
+    assert rates["config4.topk.single_stream_queries_per_s"][0] == (
+        tk["single_stream_queries_per_s"]
+    )

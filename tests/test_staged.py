@@ -1,0 +1,314 @@
+"""Staged multi-worker ingest tests (ISSUE r9): pool output bit-identical
+to serial (order + values), fault-injection crash/resume through the pool
+(the cursor never drops or double-commits a row range), clean ``break``
+closes every stage trace as abandoned and joins every worker, and the
+per-stage observability (queue gauge, stage walls, staged deliver
+events)."""
+
+import threading
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from randomprojection_tpu import GaussianRandomProjection
+from randomprojection_tpu.models.sketch import CountSketch
+from randomprojection_tpu.streaming import (
+    ArraySource,
+    FaultInjectionSource,
+    PrefetchSource,
+    StagedIngestSource,
+    StreamCursor,
+    TokenSource,
+    stream_transform,
+)
+from randomprojection_tpu.utils.observability import StreamStats
+
+
+def staged_threads():
+    return [
+        t for t in threading.enumerate() if t.name.startswith("rp-staged")
+    ]
+
+
+@pytest.fixture
+def X():
+    return np.random.default_rng(0).normal(size=(1000, 128)).astype(np.float32)
+
+
+def make_est(backend="numpy", k=16):
+    return GaussianRandomProjection(
+        n_components=k, random_state=0, backend=backend
+    )
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+@pytest.mark.parametrize("workers", [1, 2, 4])
+def test_staged_matches_serial(X, backend, workers):
+    """The pool must change WHEN batches are produced, never their order
+    or values — bit-identical to the serial stream at any worker count."""
+    est = make_est(backend).fit(X)
+    ref = list(est.transform_stream(ArraySource(X, 128)))
+    got = list(
+        est.transform_stream(
+            StagedIngestSource(
+                ArraySource(X, 128), workers=workers, depth=2,
+                prepare=est.prepare_batch,
+            )
+        )
+    )
+    assert [lo for lo, _ in got] == [lo for lo, _ in ref]
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(y) for _, y in got]),
+        np.concatenate([np.asarray(y) for _, y in ref]),
+    )
+    assert not staged_threads()
+
+
+def test_staged_token_pipeline_matches_prefetch(tmp_path):
+    """The config-5 composition: TokenSource → staged pool (per-worker
+    serial hashing) must reproduce the single-worker prefetch pipeline's
+    output exactly."""
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+
+    words = np.asarray([f"w{i}" for i in range(2000)])
+
+    def read_tokens(lo, hi):
+        rngs = [np.random.default_rng(900 + i) for i in range(lo, hi)]
+        toks = np.concatenate(
+            [words[r.integers(0, len(words), size=10)] for r in rngs]
+        )
+        return toks, np.arange(0, (hi - lo) * 10 + 10, 10)
+
+    fh = FeatureHasher(1 << 14, input_type="string", dtype=np.float32)
+    cs = CountSketch(16, random_state=0, backend="jax").fit_schema(
+        128, 1 << 14, np.float32
+    )
+    ref = np.concatenate([
+        np.asarray(y)
+        for _, y in stream_transform(
+            cs,
+            PrefetchSource(
+                TokenSource(read_tokens, 128, fh, batch_rows=32),
+                depth=2, prepare=cs.prepare_batch,
+            ),
+        )
+    ])
+    got = np.concatenate([
+        np.asarray(y)
+        for _, y in stream_transform(
+            cs,
+            StagedIngestSource(
+                TokenSource(
+                    read_tokens, 128, fh, batch_rows=32, hash_threads=1
+                ),
+                workers=3, depth=2, prepare=cs.prepare_batch,
+            ),
+        )
+    ])
+    np.testing.assert_array_equal(got, ref)
+    assert not staged_threads()
+
+
+def test_staged_validation(X):
+    with pytest.raises(ValueError, match="workers"):
+        StagedIngestSource(ArraySource(X, 128), workers=0)
+    with pytest.raises(ValueError, match="depth"):
+        StagedIngestSource(ArraySource(X, 128), depth=0)
+    with pytest.raises(ValueError, match="start_row"):
+        list(StagedIngestSource(ArraySource(X, 128)).iter_batches(3))
+
+
+def test_staged_schema_delegates(X):
+    src = StagedIngestSource(ArraySource(X, 128), workers=2)
+    assert src.schema() == ArraySource(X, 128).schema()
+    assert src.batch_rows == 128
+
+
+@pytest.mark.parametrize("workers", [2, 3])
+def test_staged_fault_resume_never_drops_or_double_commits(X, tmp_path,
+                                                           workers):
+    """A fault-injected crash through the pool must surface after the
+    in-order prefix — same prefix as the serial source — and the
+    checkpoint resume must cover every row exactly once."""
+    est = make_est().fit(X)
+    Y_ref = np.concatenate(
+        [y for _, y in est.transform_stream(ArraySource(X, 128))]
+    )
+
+    # serial reference prefix for the same fault point
+    ckpt_ref = str(tmp_path / "ref.json")
+    ref_rows = []
+    with pytest.raises(FaultInjectionSource.InjectedFault):
+        for lo, y in est.transform_stream(
+            FaultInjectionSource(ArraySource(X, 128), 3),
+            checkpoint_path=ckpt_ref,
+        ):
+            ref_rows.append(lo)
+    serial_committed = StreamCursor.load(ckpt_ref).rows_done
+
+    ckpt = str(tmp_path / "cursor.json")
+    inner = FaultInjectionSource(ArraySource(X, 128), fail_after_batches=3)
+    src = StagedIngestSource(inner, workers=workers, depth=2)
+    got = []
+    with pytest.raises(FaultInjectionSource.InjectedFault):
+        for lo, y in est.transform_stream(src, checkpoint_path=ckpt):
+            got.append((lo, y))
+    assert not staged_threads(), "every stage thread joined after the fault"
+    committed = StreamCursor.load(ckpt).rows_done
+    # the staged pool commits the identical prefix the serial source does
+    assert committed == serial_committed
+    assert committed == sum(y.shape[0] for _, y in got)
+    assert [lo for lo, _ in got] == ref_rows
+
+    inner.disarm()
+    for lo, y in est.transform_stream(src, checkpoint_path=ckpt):
+        assert lo == committed, "resume must continue at the cursor"
+        committed += y.shape[0]
+        got.append((lo, y))
+    # full coverage, no overlap, bit-identical values
+    assert [lo for lo, _ in got] == list(range(0, 1000, 128))
+    np.testing.assert_array_equal(
+        np.concatenate([y for _, y in got]), Y_ref
+    )
+    assert not staged_threads()
+
+
+def test_staged_worker_exception_in_prepare_propagates(X):
+    class PrepareBoom(RuntimeError):
+        pass
+
+    def bad_prepare(batch):
+        raise PrepareBoom("prepare failed")
+
+    est = make_est().fit(X)
+    with pytest.raises(PrepareBoom):
+        list(
+            est.transform_stream(
+                StagedIngestSource(
+                    ArraySource(X, 128), workers=2, depth=2,
+                    prepare=bad_prepare,
+                )
+            )
+        )
+    assert not staged_threads()
+
+
+def test_staged_break_joins_workers_and_abandons_traces(X, tmp_path):
+    """Clean ``break``: every stage thread joins, and every in-flight
+    trace closes as abandoned — the doctor must see zero orphaned spans
+    and only deliberate abandons."""
+    from randomprojection_tpu.utils import telemetry
+    from randomprojection_tpu.utils.trace_report import build_report
+
+    path = str(tmp_path / "events.jsonl")
+    telemetry.configure(path)
+    try:
+        est = make_est().fit(X)
+        for i, (lo, y) in enumerate(
+            est.transform_stream(
+                StagedIngestSource(ArraySource(X, 128), workers=2, depth=2)
+            )
+        ):
+            if i == 1:
+                break
+    finally:
+        telemetry.shutdown()
+    assert not staged_threads()
+    report = build_report(path)
+    assert report["spans"]["orphan_starts"] == 0, (
+        "a clean break must close every stage trace (abandoned), never "
+        "leave orphans for the doctor to misread as a crash"
+    )
+    # only batch 0 committed: the break lands mid-yield of batch 1, which
+    # therefore closes as abandoned (ack-after-yield), like everything
+    # produced ahead of it
+    assert report["traces"]["batches"] == 1
+    assert report["traces"]["incomplete"] >= 2
+    assert report["degraded"]["stream.staged.error"] == 0
+
+
+def test_staged_stats_and_deliver_events(X, tmp_path):
+    """Stage walls attribute to hash/h2d/dispatch/d2h, the final-queue
+    occupancy gauge samples once per delivered batch, and the doctor
+    reads ``stream.staged.deliver`` into its queue-depth summary."""
+    from randomprojection_tpu.ops.hashing import FeatureHasher
+    from randomprojection_tpu.utils import telemetry
+    from randomprojection_tpu.utils.trace_report import build_report
+
+    words = np.asarray([f"w{i}" for i in range(500)])
+
+    def read_tokens(lo, hi):
+        rngs = [np.random.default_rng(300 + i) for i in range(lo, hi)]
+        toks = np.concatenate(
+            [words[r.integers(0, len(words), size=10)] for r in rngs]
+        )
+        return toks, np.arange(0, (hi - lo) * 10 + 10, 10)
+
+    fh = FeatureHasher(1 << 12, input_type="string", dtype=np.float32)
+    stats = StreamStats()
+    source = StagedIngestSource(
+        TokenSource(read_tokens, 128, fh, batch_rows=32, stats=stats),
+        workers=2, depth=2, prepare=None, stats=stats,
+    )
+    cs = CountSketch(16, random_state=0, backend="jax").fit_source(source)
+    path = str(tmp_path / "events.jsonl")
+    telemetry.configure(path)
+    try:
+        rows = 0
+        for _, y in stream_transform(cs, source, stats=stats):
+            rows += y.shape[0]
+    finally:
+        telemetry.shutdown()
+    assert rows == 128
+    assert {"hash", "dispatch", "d2h"} <= set(stats.stage_wall)
+    # one occupancy sample per delivered batch, from the uploader
+    assert stats.registry.gauge("stream.queue_depth")["n"] == 4
+    report = build_report(path)
+    assert report["queue_depth"] is not None
+    assert report["queue_depth"]["samples"] == 4
+    assert report["queue_depth"]["capacity"] == 2
+    assert report["event_counts"]["stream.staged.deliver"] == 4
+    assert report["traces"]["batches"] == 4
+    assert report["spans"]["orphan_starts"] == 0
+
+
+def test_staged_empty_and_tail(X):
+    """A completed cursor (start_row == n_rows) yields nothing; a ragged
+    tail arrives in order with the right row count."""
+    est = make_est().fit(X)
+    src = StagedIngestSource(ArraySource(X, 300), workers=2)
+    assert list(src.iter_batches(1000)) == []
+    got = list(est.transform_stream(src))
+    assert [lo for lo, _ in got] == [0, 300, 600, 900]
+    assert got[-1][1].shape[0] == 100
+    assert not staged_threads()
+
+
+def test_staged_prepared_device_batches(X):
+    """CountSketch.prepare_batch on the uploader thread: DeviceBatch
+    operands flow through the staged queues and dispatch identically."""
+    rng = np.random.default_rng(3)
+    D = rng.normal(size=(300, 256)).astype(np.float32)
+    D[np.abs(D) < 1.0] = 0.0
+    Xs = sp.csr_array(D)
+    cs = CountSketch(16, random_state=0, backend="jax").fit_schema(
+        *Xs.shape, np.float32
+    )
+    got = np.concatenate([
+        np.asarray(y)
+        for _, y in stream_transform(
+            cs,
+            StagedIngestSource(
+                ArraySource(Xs, 64), workers=2, depth=2,
+                prepare=cs.prepare_batch,
+            ),
+        )
+    ])
+    ref = (
+        CountSketch(16, random_state=0, backend="numpy")
+        .fit(Xs)
+        .transform(Xs.astype(np.float64))
+    )
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+    assert not staged_threads()

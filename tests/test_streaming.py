@@ -465,3 +465,93 @@ def test_countsketch_stream_through_docmajor_kernel(monkeypatch):
         Xs.astype(np.float64)
     )
     np.testing.assert_allclose(Y, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_batch_rows_helper_tolerates_prepared_operands():
+    """ISSUE r9 satellite: the stream.dispatch rows field must survive
+    prepared operands without a plain ``.shape`` (DeviceBatch-style
+    carriers expose ``.n``), odd shapes, and unknown objects."""
+    from randomprojection_tpu.streaming import _batch_rows
+
+    assert _batch_rows(np.zeros((7, 3))) == 7
+
+    class Carrier:  # DeviceBatch-style: .n, no .shape
+        n = 42
+
+    assert _batch_rows(Carrier()) == 42
+
+    class ZeroD:  # 0-d shape: shape[0] raises IndexError
+        shape = ()
+        n = 5
+
+    assert _batch_rows(ZeroD()) == 5
+
+    class Opaque:
+        pass
+
+    assert _batch_rows(Opaque()) is None
+    assert _batch_rows(Opaque(), 0) == 0
+
+    class BadN:  # non-integral .n must not be trusted
+        n = "nope"
+
+    assert _batch_rows(BadN()) is None
+
+
+def test_stream_dispatch_rows_truthful_for_shapeless_prepared_batch(
+    X, tmp_path
+):
+    """A prepare hook that replaces batches with a shape-less carrier must
+    not crash the stream or fake the telemetry row counts: stream.dispatch
+    events and cursor commits keep the true per-batch rows (the doctor
+    treats both as truth)."""
+    from randomprojection_tpu.streaming import PrefetchSource
+    from randomprojection_tpu.utils import telemetry
+
+    class Carrier:
+        __slots__ = ("arr", "n", "nbytes")
+
+        def __init__(self, arr):
+            self.arr = arr
+            self.n = arr.shape[0]
+            self.nbytes = arr.nbytes
+
+    class StubEst:
+        def _check_is_fitted(self):
+            pass
+
+        def _stream_out_dtype(self):
+            return None
+
+        def _stream_out_width(self):
+            return X.shape[1]
+
+        def _transform_async(self, b):
+            assert isinstance(b, Carrier), "prepared carrier must arrive"
+            return b.arr * 2.0
+
+    path = str(tmp_path / "events.jsonl")
+    ckpt = str(tmp_path / "cursor.json")
+    telemetry.configure(path)
+    try:
+        got = list(
+            stream_transform(
+                StubEst(),
+                PrefetchSource(
+                    ArraySource(X, 128), depth=2, prepare=Carrier
+                ),
+                checkpoint_path=ckpt,
+            )
+        )
+    finally:
+        telemetry.shutdown()
+    np.testing.assert_array_equal(
+        np.concatenate([y for _, y in got]), X * 2.0
+    )
+    assert StreamCursor.load(ckpt).rows_done == X.shape[0]
+    dispatches = [
+        e for e in telemetry.read_events(path)
+        if e["event"] == "stream.dispatch"
+    ]
+    assert len(dispatches) == 8
+    assert [e["rows"] for e in dispatches] == [128] * 7 + [104]
