@@ -1,8 +1,13 @@
 # Repo verification entry points (ISSUE r8 satellite; r9 added the
-# staged-ingest leg).
+# staged-ingest leg; r10 the static-analysis gate).
 #
-#   make verify        tier-1 suite (the ROADMAP.md command) + a doctor
-#                      smoke run, so the telemetry/report path cannot rot
+#   make verify        rplint static analysis, then the tier-1 suite
+#                      (the ROADMAP.md command) + a doctor smoke run, so
+#                      the telemetry/report path cannot rot
+#   make lint          rplint (analysis/rplint.py via `cli lint`): span
+#                      balance, event-registry drift, hot-path host
+#                      syncs, thread hygiene, ops/ determinism, silent
+#                      swallows — non-zero on any unsuppressed finding
 #   make tier1         just the test suite
 #   make doctor-smoke  generate real telemetry files via the CLI (a
 #                      single-worker run AND a staged --ingest-workers
@@ -13,9 +18,12 @@ SHELL := /bin/bash
 PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
-.PHONY: verify tier1 doctor-smoke
+.PHONY: verify lint tier1 doctor-smoke
 
-verify: tier1 doctor-smoke
+verify: lint tier1 doctor-smoke
+
+lint:
+	$(PYTHON) -m randomprojection_tpu lint
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \

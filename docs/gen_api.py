@@ -77,6 +77,7 @@ def main():
     import randomprojection_tpu.serialize as serialize
     import randomprojection_tpu.streaming as streaming
     import randomprojection_tpu.parallel as parallel
+    from randomprojection_tpu.analysis import rplint
     from randomprojection_tpu.ops import hashing, pallas_kernels, split_matmul
     from randomprojection_tpu.parallel import distributed
     from randomprojection_tpu.utils import observability, telemetry, trace_report
@@ -92,6 +93,7 @@ def main():
         ("`randomprojection_tpu.utils.observability`", observability),
         ("`randomprojection_tpu.utils.telemetry`", telemetry),
         ("`randomprojection_tpu.utils.trace_report`", trace_report),
+        ("`randomprojection_tpu.analysis.rplint`", rplint),
     ]:
         lines += [f"## {title}", ""]
         for name in getattr(mod, "__all__", []):

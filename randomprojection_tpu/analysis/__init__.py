@@ -1,0 +1,40 @@
+"""Static analysis for the pipeline's hand-enforced contracts.
+
+The streaming/serving stack (r6–r9) is held together by conventions
+that, until this package existed, only code review enforced: spans must
+always be ended, queues must be bounded, threads must be joined, hot
+paths must not block on host syncs, emitted event names must stay in
+agreement with ``telemetry.EVENTS`` / ``trace_report`` / the docs, and
+broad ``except`` handlers must not swallow errors silently.
+
+``rplint`` is the AST-based checker that turns those conventions into
+rules (RP01–RP06, see ``rplint.RULES``), each suppressible per line with
+an inline pragma carrying a reason::
+
+    # rplint: allow[RP03] — d2h already started at dispatch
+
+Entry points: ``cli lint`` / ``make lint`` (runs over the shipped
+package and must exit 0), ``make verify`` (lint before tier-1), and the
+library surface below for programmatic use.  Pure stdlib — importing
+this package never pulls jax/numpy in.
+"""
+
+from randomprojection_tpu.analysis.rplint import (
+    RULES,
+    Finding,
+    check_registry_drift,
+    lint_package,
+    lint_source,
+    load_event_registry,
+    main,
+)
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "check_registry_drift",
+    "lint_package",
+    "lint_source",
+    "load_event_registry",
+    "main",
+]

@@ -1,0 +1,887 @@
+"""rplint — AST-based invariant checker for this repo's contracts.
+
+Generic linters cannot see the contracts the r6–r9 pipeline work relies
+on (a ``start_span`` with no exception-safe ``end_span`` is legal
+Python; an unbounded ``queue.Queue()`` is idiomatic); this checker
+encodes them as project rules over the stdlib ``ast``:
+
+- **RP01 span-balance** — a ``start_span`` whose handle neither escapes
+  its function (returned / yielded / stored / passed on, e.g. through a
+  pipeline queue) nor is closed by an ``end_span`` inside a ``finally``
+  or ``except`` block leaks its span on the error path; and the
+  ``span_start``/``span_end`` event pair may be emitted by
+  ``utils/telemetry.py`` ONLY — hand-rolled span events bypass id
+  allocation and corrupt trace reconstruction.
+- **RP02 event-registry drift** — every statically-resolvable event
+  name passed to ``emit()`` must be a member of ``telemetry.EVENTS``
+  (f-string names must extend a registered ``FAMILIES`` prefix), and
+  every registry member must be either consumed by
+  ``utils/trace_report.py`` or documented in docs/ARCHITECTURE.md.
+- **RP03 host-sync-in-hot-path** — inside loop bodies of the hot
+  modules (``HOT_MODULES``), no ``np.asarray``, ``.block_until_ready``,
+  ``jax.device_get`` or ``float()``-on-expression: a per-iteration host
+  sync serializes device compute with d2h — exactly the ``query_topk``
+  bug r9 fixed.  (Lexically scoped to loops: the commit-point fetch a
+  pipeline performs once per batch *outside* any loop is the design.)
+- **RP04 thread hygiene** — every ``threading.Thread`` is constructed
+  with an explicit ``daemon=`` and its module contains a ``.join(``;
+  every ``queue.Queue()`` is constructed with a bound.
+- **RP05 determinism** — inside ``ops/`` (kernel and hashing bodies):
+  no ``time.time()``, no global ``random.*``, no legacy
+  ``np.random.<fn>`` calls (Generator construction is allowed) — RNG
+  and clocks are threaded explicitly so kernels stay replayable.
+- **RP06 silent-swallow** — broad ``except`` handlers (bare /
+  ``Exception`` / ``BaseException``) in the pipeline/serving modules
+  must re-raise, emit telemetry, or close the active span.
+
+Suppression pragma (same line as the finding, or the line directly
+above it)::
+
+    # rplint: allow[RP03] — d2h already started at dispatch
+    # rplint: allow[RP04,RP06] — reason covering both rules
+
+The reason is mandatory; a pragma that does not parse, names an unknown
+rule, or omits the reason is itself reported (RP00) and suppresses
+nothing.  ``main()`` exits non-zero on any unsuppressed finding;
+``--json`` emits the stable findings schema (``rplint`` version, rule
+id, path, line, message, pragma state) for the bench/record machinery.
+
+The analysis is intraprocedural and syntactic by design — it prefers
+missing an exotic violation over flagging correct code, because every
+false positive costs a pragma in the tree forever.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "EventRegistry",
+    "load_event_registry",
+    "check_registry_drift",
+    "lint_source",
+    "lint_package",
+    "package_root",
+    "main",
+]
+
+RULES = {
+    "RP00": "pragma hygiene: rplint pragmas parse as "
+            "`# rplint: allow[RPxx] — <reason>` with known rules and a "
+            "reason",
+    "RP01": "span-balance: start_span handles escape or end in a "
+            "finally/except; span_* events are emitted only by "
+            "utils/telemetry.py",
+    "RP02": "event-registry drift: emitted event names live in "
+            "telemetry.EVENTS, and every registry entry is consumed by "
+            "trace_report.py or documented in ARCHITECTURE.md",
+    "RP03": "host-sync-in-hot-path: no np.asarray / .block_until_ready / "
+            "jax.device_get / float()-on-expression inside loop bodies of "
+            "the hot modules",
+    "RP04": "thread hygiene: threading.Thread has explicit daemon= and a "
+            ".join( in the module; queue.Queue is bounded",
+    "RP05": "determinism: no time.time(), global random.*, or legacy "
+            "np.random.<fn> inside ops/",
+    "RP06": "silent-swallow: broad except handlers in pipeline modules "
+            "re-raise, emit telemetry, or close the span",
+}
+
+# -- rule scoping (paths are package-relative, '/'-separated) ----------------
+
+TELEMETRY_MODULE = "utils/telemetry.py"
+TRACE_REPORT_MODULE = "utils/trace_report.py"
+ARCHITECTURE_DOC = os.path.join("docs", "ARCHITECTURE.md")
+# RP03: the modules whose loops are the streamed/serving hot sections
+HOT_MODULES = (
+    "streaming.py",
+    "backends/jax_backend.py",
+    "ops/pallas_kernels.py",
+    "models/sketch.py",
+)
+# RP06: modules on the pipeline/serving path where a swallowed error
+# strands a stream, a future, or a telemetry file
+PIPELINE_MODULES = HOT_MODULES + (
+    "ops/hashing.py",
+    "utils/observability.py",
+    TELEMETRY_MODULE,
+)
+DETERMINISM_PREFIXES = ("ops/",)
+# RP05: Generator-construction surface of np.random that stays legal
+RNG_FACTORY_OK = frozenset(
+    {"default_rng", "Generator", "SeedSequence", "PCG64", "Philox",
+     "MT19937", "bit_generator"}
+)
+# RP06: a handler containing a call to one of these has routed the error
+# somewhere observable (record_vmem_oom_retry is the shared degraded-
+# retry recorder — it emits + counts for both VMEM-OOM call sites)
+RP06_MITIGATORS = frozenset(
+    {"emit", "counter_inc", "end_span", "record_vmem_oom_retry"}
+)
+
+_PRAGMA_RE = re.compile(r"#\s*rplint:\s*(.*)$")
+_ALLOW_RE = re.compile(
+    r"^allow\[([A-Za-z0-9_,\s]+)\]\s*(?:[—–]|--|-)\s*(\S.*)$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding; ``suppressed`` marks a pragma'd (accepted)
+    violation, ``reason`` carries the pragma's justification."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "reason": self.reason,
+        }
+
+    def render(self) -> str:
+        sup = "  [suppressed: %s]" % self.reason if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{sup}"
+
+
+# -- pragma scanning ---------------------------------------------------------
+
+
+def _scan_pragmas(
+    src: str, relpath: str
+) -> Tuple[Dict[int, Tuple[set, str]], List[Finding]]:
+    """``{line: (rules, reason)}`` for every well-formed allow pragma,
+    plus RP00 findings for malformed ones.  Comment tokens only — a
+    pragma-shaped string literal is never a pragma."""
+    allows: Dict[int, Tuple[set, str]] = {}
+    findings: List[Finding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        return allows, findings  # ast.parse already reported the syntax
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA_RE.search(tok.string)
+        if m is None:
+            continue
+        line = tok.start[0]
+        am = _ALLOW_RE.match(m.group(1).strip())
+        if am is None:
+            findings.append(Finding(
+                "RP00", relpath, line,
+                "unparseable rplint pragma (grammar: "
+                "`# rplint: allow[RPxx] — <reason>`, reason required)",
+            ))
+            continue
+        rules = {r.strip().upper() for r in am.group(1).split(",")
+                 if r.strip()}
+        unknown = sorted(rules - set(RULES))
+        if unknown:
+            # the whole pragma is void, including any known rules it
+            # also names — a malformed pragma suppresses NOTHING, so a
+            # typo can never silently accept a violation
+            findings.append(Finding(
+                "RP00", relpath, line,
+                f"pragma names unknown rule(s): {', '.join(unknown)} — "
+                "the pragma suppresses nothing",
+            ))
+            continue
+        if rules:
+            prev = allows.get(line)
+            if prev is not None:
+                rules |= prev[0]
+            allows[line] = (rules, am.group(2).strip())
+    return allows, findings
+
+
+# -- small AST helpers -------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> str:
+    """Dotted-name string of a Name/Attribute chain ('' when dynamic)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _callee(call: ast.Call) -> str:
+    """Last path component of the callee ('emit' for telemetry.emit)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {
+        child: parent
+        for parent in ast.walk(tree)
+        for child in ast.iter_child_nodes(parent)
+    }
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _own_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """Nodes lexically owned by ``scope``: its subtree minus the bodies
+    of nested function definitions (each nested def owns its own)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _FUNC_NODES + (ast.Lambda,)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _scopes(tree: ast.Module) -> List[ast.AST]:
+    """The module plus every (possibly nested) function definition."""
+    return [tree] + [
+        n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)
+    ]
+
+
+def _imports_name(tree: ast.Module, module_suffix: str, name: str) -> bool:
+    """True when ``from <...module_suffix> import <name>`` appears."""
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom) and n.module and (
+            n.module == module_suffix
+            or n.module.endswith("." + module_suffix)
+        ):
+            if any(a.name == name for a in n.names):
+                return True
+    return False
+
+
+def _is_emit_call(call: ast.Call, *, in_telemetry: bool,
+                  emit_imported: bool) -> bool:
+    """A call of the package's ``emit()``: ``telemetry.emit(...)``, a
+    directly-imported ``emit(...)``, or (inside telemetry.py itself) the
+    module-level ``emit(...)``.  ``TelemetryLog.emit``/arbitrary
+    ``x.emit`` methods don't count — the registry governs the
+    process-wide event stream, not every method named emit."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "emit":
+        base = _dotted(f.value)
+        return base == "telemetry" or base.endswith(".telemetry")
+    if isinstance(f, ast.Name) and f.id == "emit":
+        return emit_imported or in_telemetry
+    return False
+
+
+# -- the event registry (RP02) -----------------------------------------------
+
+
+@dataclasses.dataclass
+class EventRegistry:
+    """Statically-parsed view of ``telemetry.EVENTS``: constant name →
+    event string (families excluded), family prefixes, and the source
+    line of each constant (so drift findings anchor to the registry)."""
+
+    events: Dict[str, str]
+    families: Tuple[str, ...]
+    lines: Dict[str, int]
+
+    def knows(self, name: str) -> bool:
+        return name in self.events.values() or any(
+            name.startswith(f) for f in self.families
+        )
+
+
+def load_event_registry(telemetry_src: str) -> Optional[EventRegistry]:
+    """Parse the ``EVENTS`` class out of telemetry.py source (static —
+    the linter never imports the package it checks)."""
+    try:
+        tree = ast.parse(telemetry_src)
+    except SyntaxError:
+        return None
+    cls = next(
+        (n for n in ast.walk(tree)
+         if isinstance(n, ast.ClassDef) and n.name == "EVENTS"),
+        None,
+    )
+    if cls is None:
+        return None
+    events: Dict[str, str] = {}
+    lines: Dict[str, int] = {}
+    families: List[str] = []
+    for stmt in cls.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            continue
+        attr = stmt.targets[0].id
+        if attr == "FAMILIES" and isinstance(stmt.value, ast.Tuple):
+            families.extend(
+                e.value for e in stmt.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+            continue
+        if not (isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            continue
+        if attr.endswith("_FAMILY"):
+            families.append(stmt.value.value)
+            continue
+        events[attr] = stmt.value.value
+        lines[attr] = stmt.lineno
+    return EventRegistry(events, tuple(dict.fromkeys(families)), lines)
+
+
+def check_registry_drift(
+    registry: EventRegistry,
+    consumer_text: str,
+    doc_text: str,
+    telemetry_relpath: str = TELEMETRY_MODULE,
+) -> List[Finding]:
+    """RP02, registry side: every entry must be consumed by trace_report
+    (by literal value or ``EVENTS.<NAME>`` reference) or documented in
+    ARCHITECTURE.md — an event nobody reads and nobody documents is
+    dead weight drifting away from reality."""
+    findings = []
+    for attr, value in sorted(registry.events.items()):
+        consumed = (
+            value in consumer_text or f"EVENTS.{attr}" in consumer_text
+        )
+        documented = value in doc_text
+        if not (consumed or documented):
+            findings.append(Finding(
+                "RP02", telemetry_relpath,
+                registry.lines.get(attr, 1),
+                f"registry event {value!r} ({attr}) is neither consumed "
+                "by trace_report.py nor documented in ARCHITECTURE.md",
+            ))
+    return findings
+
+
+# -- rules -------------------------------------------------------------------
+
+
+def _rule_rp01(tree: ast.Module, relpath: str,
+               parents: Dict[ast.AST, ast.AST],
+               emit_imported: bool) -> List[Finding]:
+    out: List[Finding] = []
+    in_telemetry = relpath == TELEMETRY_MODULE
+
+    if not in_telemetry:
+        for call in (n for n in ast.walk(tree) if isinstance(n, ast.Call)):
+            if not _is_emit_call(call, in_telemetry=False,
+                                 emit_imported=emit_imported):
+                continue
+            a0 = call.args[0] if call.args else None
+            if (isinstance(a0, ast.Constant) and isinstance(a0.value, str)
+                    and a0.value.startswith("span_")):
+                out.append(Finding(
+                    "RP01", relpath, call.lineno,
+                    f"emit of span event {a0.value!r} outside "
+                    "utils/telemetry.py — use span()/start_span()/"
+                    "end_span(), never hand-rolled span events",
+                ))
+
+    for scope in _scopes(tree):
+        own = list(_own_nodes(scope))
+        starts = [
+            n for n in own
+            if isinstance(n, ast.Call) and _callee(n) == "start_span"
+        ]
+        if not starts:
+            continue
+        protected = _has_protected_end(own)
+        for call in starts:
+            if _start_span_ok(call, own, parents, protected):
+                continue
+            out.append(Finding(
+                "RP01", relpath, call.lineno,
+                "start_span handle neither escapes this function nor is "
+                "closed by an end_span inside a finally/except — the span "
+                "leaks on the error path; use the span() context manager "
+                "or end it in a finally",
+            ))
+    return out
+
+
+def _has_protected_end(own: Sequence[ast.AST]) -> bool:
+    """An ``end_span`` call inside a ``finally`` or ``except`` of this
+    scope (exception-safe close)."""
+    for n in own:
+        if not isinstance(n, ast.Try):
+            continue
+        regions = list(n.finalbody)
+        for h in n.handlers:
+            regions.extend(h.body)
+        for stmt in regions:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and _callee(sub) == "end_span":
+                    return True
+    return False
+
+
+def _start_span_ok(call: ast.Call, own: Sequence[ast.AST],
+                   parents: Dict[ast.AST, ast.AST],
+                   protected: bool) -> bool:
+    p = parents.get(call)
+    # handle used directly: returned/yielded, element of a container, or
+    # argument of another call — it escapes, the receiver owns ending it
+    if isinstance(p, (ast.Return, ast.Yield, ast.Tuple, ast.List,
+                      ast.keyword)):
+        return True
+    if isinstance(p, ast.Call) and p is not call:
+        return True
+    if isinstance(p, ast.Assign) and len(p.targets) == 1:
+        tgt = p.targets[0]
+        if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            return True  # stored on an object: lifecycle escapes
+        if isinstance(tgt, ast.Name):
+            return protected or _name_escapes(own, tgt.id)
+    # bare expression statement: the handle is discarded — nothing can
+    # ever end this span, protected ends elsewhere notwithstanding
+    return False
+
+
+def _name_escapes(own: Sequence[ast.AST], name: str) -> bool:
+    """The bound handle leaves the scope: returned/yielded, placed in a
+    container, stored through an attribute/subscript, or passed to a
+    call that may own it (activate_span/end_span/trace_fields read the
+    span without taking ownership and don't count)."""
+    non_owning = {"end_span", "activate_span", "trace_fields"}
+
+    def contains(sub: ast.AST) -> bool:
+        return any(
+            isinstance(x, ast.Name) and x.id == name
+            for x in ast.walk(sub)
+        )
+
+    for n in own:
+        if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+            if n.value is not None and contains(n.value):
+                return True
+        elif isinstance(n, (ast.Tuple, ast.List, ast.Set)):
+            if any(isinstance(e, ast.Name) and e.id == name
+                   for e in n.elts):
+                return True
+        elif isinstance(n, ast.Call) and _callee(n) not in non_owning:
+            if any(contains(a) for a in n.args) or any(
+                contains(k.value) for k in n.keywords
+            ):
+                return True
+        elif isinstance(n, ast.Assign):
+            if contains(n.value) and any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in n.targets
+            ):
+                return True
+    return False
+
+
+def _rule_rp02(tree: ast.Module, relpath: str,
+               registry: Optional[EventRegistry],
+               emit_imported: bool) -> List[Finding]:
+    if registry is None:
+        return []
+    out: List[Finding] = []
+    in_telemetry = relpath == TELEMETRY_MODULE
+    for call in (n for n in ast.walk(tree) if isinstance(n, ast.Call)):
+        if not _is_emit_call(call, in_telemetry=in_telemetry,
+                             emit_imported=emit_imported):
+            continue
+        a0 = call.args[0] if call.args else None
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+            if not registry.knows(a0.value):
+                out.append(Finding(
+                    "RP02", relpath, call.lineno,
+                    f"emit of event {a0.value!r} not registered in "
+                    "telemetry.EVENTS — add it to the registry (and "
+                    "consume or document it)",
+                ))
+        elif isinstance(a0, ast.Attribute):
+            base = _dotted(a0.value)
+            if base == "EVENTS" or base.endswith(".EVENTS"):
+                if a0.attr not in registry.events:
+                    out.append(Finding(
+                        "RP02", relpath, call.lineno,
+                        f"emit references unknown registry constant "
+                        f"EVENTS.{a0.attr}",
+                    ))
+            # other attributes (a variable's field) are dynamic: skip
+        elif isinstance(a0, ast.JoinedStr):
+            prefix = ""
+            for part in a0.values:
+                if isinstance(part, ast.Constant) and isinstance(
+                    part.value, str
+                ):
+                    prefix += part.value
+                else:
+                    break
+            if not any(prefix.startswith(f) for f in registry.families):
+                out.append(Finding(
+                    "RP02", relpath, call.lineno,
+                    f"f-string event name (static prefix {prefix!r}) does "
+                    "not extend any registered EVENTS.FAMILIES prefix",
+                ))
+    return out
+
+
+_HOST_SYNCS = {"asarray": ("np", "numpy"), "device_get": ("jax",)}
+
+
+def _rule_rp03(tree: ast.Module, relpath: str) -> List[Finding]:
+    out: List[Finding] = []
+    seen: set = set()
+    loops = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.For, ast.While, ast.AsyncFor))
+    ]
+    for loop in loops:
+        for n in ast.walk(loop):
+            if not isinstance(n, ast.Call) or id(n) in seen:
+                continue
+            f = n.func
+            what = None
+            if isinstance(f, ast.Attribute):
+                bases = _HOST_SYNCS.get(f.attr)
+                if bases and isinstance(f.value, ast.Name) and (
+                    f.value.id in bases
+                ):
+                    what = f"{f.value.id}.{f.attr}"
+                elif f.attr == "block_until_ready":
+                    what = ".block_until_ready()"
+            elif isinstance(f, ast.Name) and f.id == "float" and n.args:
+                # float(scalar_name) is fine; float(<expression>) on an
+                # array element/reduction forces a device sync
+                if not isinstance(n.args[0], (ast.Name, ast.Constant)):
+                    what = "float() on an expression"
+            if what is not None:
+                seen.add(id(n))
+                out.append(Finding(
+                    "RP03", relpath, n.lineno,
+                    f"{what} inside a loop body of a hot module blocks "
+                    "on a host sync every iteration — overlap the fetch "
+                    "(copy_to_host_async + materialize one behind) or "
+                    "hoist it out of the loop",
+                ))
+    return out
+
+
+def _rule_rp04(tree: ast.Module, relpath: str) -> List[Finding]:
+    out: List[Finding] = []
+    thread_imported = _imports_name(tree, "threading", "Thread")
+    queue_imported = any(
+        _imports_name(tree, "queue", n) for n in ("Queue", "LifoQueue")
+    )
+    has_join = False
+    threads: List[ast.Call] = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Attribute) and f.attr == "join":
+            # "sep".join(...) is string plumbing, not thread hygiene
+            if not (isinstance(f.value, ast.Constant)
+                    and isinstance(f.value.value, str)):
+                has_join = True
+        is_thread = (
+            isinstance(f, ast.Attribute) and f.attr == "Thread"
+            and _dotted(f.value).split(".")[-1] == "threading"
+        ) or (
+            isinstance(f, ast.Name) and f.id == "Thread" and thread_imported
+        )
+        if is_thread:
+            threads.append(n)
+            if not any(k.arg == "daemon" for k in n.keywords):
+                out.append(Finding(
+                    "RP04", relpath, n.lineno,
+                    "threading.Thread constructed without an explicit "
+                    "daemon= — decide (and document) whether this thread "
+                    "may outlive interpreter shutdown",
+                ))
+        is_queue = (
+            isinstance(f, ast.Attribute) and f.attr in ("Queue", "LifoQueue")
+            and _dotted(f.value).split(".")[-1] in ("queue", "_queue")
+        ) or (
+            isinstance(f, ast.Name) and f.id in ("Queue", "LifoQueue")
+            and queue_imported
+        )
+        if is_queue:
+            bound = None
+            if n.args:
+                bound = n.args[0]
+            for k in n.keywords:
+                if k.arg == "maxsize":
+                    bound = k.value
+            # Python treats ANY maxsize <= 0 as unbounded: catch the
+            # literal 0 and the negated-literal (-1) spellings alike
+            val = None
+            if isinstance(bound, ast.Constant) and isinstance(
+                bound.value, (int, float)
+            ):
+                val = bound.value
+            elif (isinstance(bound, ast.UnaryOp)
+                    and isinstance(bound.op, ast.USub)
+                    and isinstance(bound.operand, ast.Constant)
+                    and isinstance(bound.operand.value, (int, float))):
+                val = -bound.operand.value
+            if bound is None or (val is not None and val <= 0):
+                out.append(Finding(
+                    "RP04", relpath, n.lineno,
+                    "unbounded queue.Queue() — a stalled consumer grows "
+                    "it without limit; construct with a maxsize bound",
+                ))
+    if threads and not has_join:
+        for n in threads:
+            out.append(Finding(
+                "RP04", relpath, n.lineno,
+                "threading.Thread constructed but no .join( appears in "
+                "this module — threads must be joined (bounded) on "
+                "shutdown",
+            ))
+    return out
+
+
+def _rule_rp05(tree: ast.Module, relpath: str) -> List[Finding]:
+    out: List[Finding] = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call) or not isinstance(
+            n.func, ast.Attribute
+        ):
+            continue
+        base = _dotted(n.func.value)
+        attr = n.func.attr
+        if base in ("time", "_time") and attr == "time":
+            out.append(Finding(
+                "RP05", relpath, n.lineno,
+                "time.time() in ops/ — wall clocks don't belong in "
+                "kernel bodies; take timestamps at the call site or use "
+                "perf_counter in instrumentation",
+            ))
+        elif base == "random":
+            out.append(Finding(
+                "RP05", relpath, n.lineno,
+                f"global random.{attr}() in ops/ — RNG must be threaded "
+                "explicitly (np.random.Generator / jax key)",
+            ))
+        elif base in ("np.random", "numpy.random") and (
+            attr not in RNG_FACTORY_OK
+        ):
+            out.append(Finding(
+                "RP05", relpath, n.lineno,
+                f"legacy np.random.{attr}() in ops/ mutates hidden "
+                "global state — pass an np.random.Generator instead",
+            ))
+    return out
+
+
+def _rule_rp06(tree: ast.Module, relpath: str) -> List[Finding]:
+    out: List[Finding] = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.ExceptHandler):
+            continue
+        t = n.type
+        broad = t is None or (
+            isinstance(t, (ast.Name, ast.Attribute))
+            and _dotted(t).split(".")[-1] in ("Exception", "BaseException")
+        )
+        if not broad:
+            continue
+        handled = False
+        for stmt in n.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    handled = True
+                elif isinstance(sub, ast.Call) and (
+                    _callee(sub) in RP06_MITIGATORS
+                ):
+                    handled = True
+        if not handled:
+            out.append(Finding(
+                "RP06", relpath, n.lineno,
+                "broad except handler swallows the error silently — "
+                "re-raise, emit a telemetry event/counter, or close the "
+                "span as errored",
+            ))
+    return out
+
+
+# -- engine ------------------------------------------------------------------
+
+
+def lint_source(src: str, relpath: str, *,
+                registry: Optional[EventRegistry] = None) -> List[Finding]:
+    """Lint one module's source.  ``relpath`` is the package-relative
+    path ('/'-separated) the rule scoping keys on; tests lint fixture
+    text under virtual relpaths to exercise module-scoped rules."""
+    relpath = relpath.replace(os.sep, "/")
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(
+            "RP00", relpath, e.lineno or 1, f"syntax error: {e.msg}"
+        )]
+    allows, findings = _scan_pragmas(src, relpath)
+    parents = _parents(tree)
+    emit_imported = _imports_name(tree, "telemetry", "emit")
+    findings += _rule_rp01(tree, relpath, parents, emit_imported)
+    findings += _rule_rp02(tree, relpath, registry, emit_imported)
+    if relpath in HOT_MODULES:
+        findings += _rule_rp03(tree, relpath)
+    findings += _rule_rp04(tree, relpath)
+    if relpath.startswith(DETERMINISM_PREFIXES):
+        findings += _rule_rp05(tree, relpath)
+    if relpath in PIPELINE_MODULES:
+        findings += _rule_rp06(tree, relpath)
+    for f in findings:
+        if f.rule == "RP00":
+            continue  # pragma hygiene is not itself suppressible
+        for ln in (f.line, f.line - 1):
+            a = allows.get(ln)
+            if a is not None and f.rule in a[0]:
+                f.suppressed = True
+                f.reason = a[1]
+                break
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def package_root() -> str:
+    """The installed ``randomprojection_tpu`` package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def iter_package_files(root: str) -> List[str]:
+    rels: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__"
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                rels.append(rel.replace(os.sep, "/"))
+    return rels
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def lint_package(root: Optional[str] = None,
+                 files: Optional[Sequence[str]] = None) -> dict:
+    """Lint the package tree (or an explicit file list) and return the
+    stable findings record the CLI serializes with ``--json``:
+    ``{rplint, root, files, findings[], counts, suppressed, ok}`` —
+    rule id / path / line / message / pragma state per finding."""
+    root = os.path.abspath(root or package_root())
+    registry = load_event_registry(
+        _read(os.path.join(root, TELEMETRY_MODULE.replace("/", os.sep)))
+    )
+    if files is None:
+        rels = iter_package_files(root)
+        paths = [(os.path.join(root, r.replace("/", os.sep)), r)
+                 for r in rels]
+        run_drift = True
+    else:
+        paths = []
+        for p in files:
+            ap = os.path.abspath(p)
+            rel = os.path.relpath(ap, root)
+            if rel.startswith(".."):
+                rel = os.path.basename(ap)
+            paths.append((ap, rel.replace(os.sep, "/")))
+        run_drift = False
+    findings: List[Finding] = []
+    for abspath, rel in paths:
+        findings += lint_source(_read(abspath), rel, registry=registry)
+    doc_path = os.path.join(os.path.dirname(root), ARCHITECTURE_DOC)
+    if run_drift and registry is not None and os.path.exists(doc_path):
+        # the drift check is a repo-time gate: an installed package
+        # ships without docs/ (pyproject packages only the code), and
+        # flagging every documented-only event there would fail a
+        # correct tree.  The repo checkout always has the doc (and the
+        # tier-1 suite asserts the check runs there).
+        consumer = _read(
+            os.path.join(root, TRACE_REPORT_MODULE.replace("/", os.sep))
+        )
+        findings += check_registry_drift(registry, consumer, _read(doc_path))
+    active = [f for f in findings if not f.suppressed]
+    counts: Dict[str, int] = {}
+    for f in active:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "rplint": 1,
+        "root": root,
+        "files": len(paths),
+        "findings": [f.to_dict() for f in findings],
+        "counts": dict(sorted(counts.items())),
+        "suppressed": len(findings) - len(active),
+        "ok": not active,
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI face (``cli lint`` delegates here).  Exit 0 iff no
+    unsuppressed finding."""
+    ap = argparse.ArgumentParser(
+        prog="rplint",
+        description="AST-based invariant checks for this repo's "
+                    "pipeline contracts (rules RP01-RP06; see "
+                    "randomprojection_tpu/analysis/rplint.py)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: the whole installed "
+                         "package, plus the registry drift check)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the stable findings record as one JSON "
+                         "object (includes suppressed findings, marked)")
+    ap.add_argument("--root", default=None,
+                    help="package root to resolve rule scoping against "
+                         "(default: the installed package)")
+    args = ap.parse_args(argv)
+    report = lint_package(args.root, files=args.paths or None)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        shown = [
+            Finding(**f) for f in report["findings"] if not f["suppressed"]
+        ]
+        for f in shown:
+            print(f.render())
+        status = "clean" if report["ok"] else (
+            "%d finding(s)" % len(shown)
+        )
+        print(
+            f"rplint: {status} — {report['files']} file(s), "
+            f"{report['suppressed']} suppressed finding(s)"
+        )
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":  # pragma: no cover — python -m convenience
+    raise SystemExit(main())

@@ -554,7 +554,8 @@ class JaxBackend(ProjectionBackend):
             # stage span is active on this thread, so the backend's own
             # dispatch record correlates with its batch trace
             telemetry.emit(
-                "backend.dispatch", kind=spec.kind, rows=int(n),
+                telemetry.EVENTS.BACKEND_DISPATCH, kind=spec.kind,
+                rows=int(n),
                 n_features=spec.n_features, n_components=spec.n_components,
                 device_resident=bool(device_resident),
                 **telemetry.trace_fields(),

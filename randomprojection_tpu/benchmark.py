@@ -864,7 +864,7 @@ def measure_config4_topk(preset: str = "full") -> dict:
                 errs.append(e)
 
         threads = [
-            threading.Thread(target=client, args=(ci,))
+            threading.Thread(target=client, args=(ci,), daemon=True)
             for ci in range(clients)
         ]
         for t in threads:

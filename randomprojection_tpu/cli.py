@@ -14,6 +14,9 @@ SURVEY.md §6 config/flag system):
 - ``doctor``        per-batch critical-path report from a telemetry
                     JSONL file (alias: ``report``) — stage waterfall,
                     bubbles, degraded-event audit, tripwire status
+- ``lint``          rplint: AST-based checks of the pipeline's invariants
+                    (span balance, event-registry drift, hot-path host
+                    syncs, thread hygiene, determinism, silent swallows)
 """
 
 from __future__ import annotations
@@ -165,6 +168,28 @@ def build_parser():
     q.add_argument("--json", action="store_true",
                    help="print the report as one JSON object instead of "
                         "the rendered text")
+
+    q = sub.add_parser(
+        "lint",
+        help="rplint: AST-based invariant checks (rules RP01-RP06)",
+        description="Run the project's static-analysis pass "
+                    "(randomprojection_tpu/analysis/rplint.py) over the "
+                    "installed package: span balance, telemetry.EVENTS "
+                    "registry drift, host syncs in hot-path loops, "
+                    "thread/queue hygiene, ops/ determinism and "
+                    "silently-swallowed exceptions.  Exits non-zero on "
+                    "any finding not suppressed by an inline "
+                    "`# rplint: allow[RPxx] — reason` pragma.  Pure "
+                    "stdlib AST analysis: never imports or executes the "
+                    "code it checks.",
+    )
+    q.add_argument("paths", nargs="*", metavar="PATH",
+                   help="specific files to lint (default: the whole "
+                        "package plus the registry drift check)")
+    q.add_argument("--json", action="store_true",
+                   help="emit the stable findings record as one JSON "
+                        "object: rplint version, per-finding rule id / "
+                        "path / line / message / pragma state, counts")
 
     q = sub.add_parser(
         "topk-bench",
@@ -476,6 +501,18 @@ def cmd_doctor(args):
         print(render_report(report), end="")
 
 
+def cmd_lint(args):
+    """rplint over the package (or explicit paths); returns the exit
+    code — non-zero on unsuppressed findings, so `make lint` and the
+    tier-1 suite gate on a clean tree."""
+    from randomprojection_tpu.analysis import rplint
+
+    argv = list(args.paths)
+    if args.json:
+        argv.append("--json")
+    return rplint.main(argv)
+
+
 def cmd_bench(args):
     from randomprojection_tpu.benchmark import emit_bench_output, run
 
@@ -533,7 +570,9 @@ def cmd_topk_bench(args):
     per_client = [requests[i :: args.clients] for i in range(args.clients)]
     results: list = [[] for _ in range(args.clients)]
     threads = [
-        threading.Thread(target=client, args=(per_client[i], results[i]))
+        threading.Thread(
+            target=client, args=(per_client[i], results[i]), daemon=True
+        )
         for i in range(args.clients)
     ]
     t0 = time.perf_counter()
@@ -697,6 +736,7 @@ def main(argv=None):
         "topk-bench": cmd_topk_bench,
         "doctor": cmd_doctor,
         "report": cmd_doctor,  # alias
+        "lint": cmd_lint,
     }[args.cmd](args)
     # fallback for commands that didn't write their own (e.g. bench);
     # project/stream-bench merge their StreamStats registry in and

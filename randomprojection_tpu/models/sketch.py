@@ -31,6 +31,7 @@ from randomprojection_tpu.models.base import (
     _resolve_seed,
 )
 from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
 from randomprojection_tpu.utils.validation import NotFittedError, check_array
 
 __all__ = [
@@ -401,6 +402,7 @@ class SimHashIndex:
             lo, hi, handles = entry
             col = 0
             for c, h in zip(self._chunks, handles):
+                # rplint: allow[RP03] — d2h already started at dispatch
                 out[lo:hi, col : col + c.n] = np.asarray(h)[:, : c.n]
                 col += c.n
 
@@ -420,7 +422,7 @@ class SimHashIndex:
             )
             if telemetry.enabled():
                 telemetry.emit(
-                    "simhash.query_tile", queries=int(hi - lo),
+                    EVENTS.SIMHASH_QUERY_TILE, queries=int(hi - lo),
                     chunks=len(self._chunks), n_codes=self.n_codes,
                     **telemetry.trace_fields(),
                 )
@@ -504,7 +506,7 @@ class SimHashIndex:
             # serve it through the dense path rather than raising
             telemetry.registry().counter_inc("simhash.topk_dense_fallbacks")
             telemetry.emit(
-                "simhash.topk_dense_fallback", m=int(m_eff),
+                EVENTS.SIMHASH_TOPK_DENSE_FALLBACK, m=int(m_eff),
                 n_codes=self.n_codes, n_bits=self.n_bytes * 8,
             )
             out_d = np.empty((A.shape[0], m_eff), dtype=np.int32)
@@ -534,7 +536,9 @@ class SimHashIndex:
             cand_d, cand_i = [], []
             base = 0
             for c, (d, i) in zip(self._chunks, handles):
+                # rplint: allow[RP03] — d2h already started at dispatch
                 cand_d.append(np.asarray(d))
+                # rplint: allow[RP03] — d2h already started at dispatch
                 cand_i.append(np.asarray(i).astype(np.int64) + base)
                 base += c.n
             d = np.concatenate(cand_d, axis=1)
@@ -566,7 +570,8 @@ class SimHashIndex:
             )
             if telemetry.enabled():
                 telemetry.emit(
-                    "simhash.topk_tile", queries=int(hi - lo), m=int(m_eff),
+                    EVENTS.SIMHASH_TOPK_TILE, queries=int(hi - lo),
+                    m=int(m_eff),
                     chunks=len(self._chunks), n_codes=self.n_codes,
                     **telemetry.trace_fields(),
                 )
@@ -629,7 +634,7 @@ class SimHashIndex:
             # recorded so a throughput drop has its cause on file
             telemetry.registry().counter_inc("simhash.topk_block_clamps")
             telemetry.emit(
-                "simhash.topk_block_clamp", requested=int(blk_requested),
+                EVENTS.SIMHASH_TOPK_BLOCK_CLAMP, requested=int(blk_requested),
                 clamped=int(blk), m=int(m_c), n_bits=n_bits_total,
             )
         width = m_c + blk  # packing base W
@@ -764,15 +769,25 @@ class TopKServer:
     Shutdown: ``close()`` (or leaving the context manager) serves every
     request already submitted, then stops the dispatcher; a
     ``submit()`` after close fails fast.  A request whose batch failed
-    on device receives the exception through its future; the server
+    on device receives the exception through its future (and the server
+    emits a ``serve.topk.error`` event + ``serve.topk.errors`` counter —
+    a failing device must not be invisible to telemetry); the server
     itself keeps serving subsequent batches.
+
+    Backpressure: the submit queue is BOUNDED (``max_pending``
+    requests).  A dispatcher that stalls — a hung device, a wedged
+    ``query_topk`` — must surface as a fast, explicit failure at the
+    submitting client, not as unbounded host-memory growth in a queue
+    nobody is draining: once ``max_pending`` requests are waiting,
+    ``submit()`` raises ``RuntimeError`` (counted in
+    ``serve.topk.rejects``) instead of enqueueing.
     """
 
     _SENTINEL = object()
 
     def __init__(self, index: "SimHashIndex", m: int, *,
                  max_batch: int = 8192, max_delay_s: float = 0.002,
-                 start: bool = True):
+                 max_pending: int = 8192, start: bool = True):
         if not isinstance(m, numbers.Integral) or m <= 0:
             raise ValueError(f"m must be a positive int, got {m!r}")
         if not isinstance(max_batch, numbers.Integral) or max_batch < 1:
@@ -783,13 +798,20 @@ class TopKServer:
             raise ValueError(
                 f"max_delay_s must be >= 0, got {max_delay_s!r}"
             )
+        if not isinstance(max_pending, numbers.Integral) or max_pending < 1:
+            raise ValueError(
+                f"max_pending must be a positive int, got {max_pending!r}"
+            )
         self.index = index
         self.m = int(m)
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_s)
+        self.max_pending = int(max_pending)
         import queue as _queue
 
-        self._q: "_queue.Queue" = _queue.Queue()
+        # bounded: a stalled drain rejects new submits (see class doc)
+        # instead of growing the queue without limit (ISSUE r10)
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=self.max_pending + 1)
         self._closed = threading.Event()
         # serializes submit's closed-check+put against close's
         # set+sentinel: every accepted request is enqueued AHEAD of the
@@ -851,7 +873,18 @@ class TopKServer:
         with self._submit_lock:
             if self._closed.is_set():
                 raise RuntimeError("TopKServer is closed")
-            self._q.put((codes, fut))
+            # submits are serialized by the lock and the dispatcher only
+            # drains, so this check is the bound: the queue can never
+            # exceed max_pending requests, and close()'s sentinel always
+            # fits in the reserved extra slot without blocking
+            if self._q.qsize() >= self.max_pending:
+                telemetry.registry().counter_inc("serve.topk.rejects")
+                raise RuntimeError(
+                    f"TopKServer submit queue is full (max_pending="
+                    f"{self.max_pending} requests waiting; the dispatcher "
+                    "is not draining — device hung or server overloaded)"
+                )
+            self._q.put_nowait((codes, fut))
         return fut
 
     def query(self, codes):
@@ -917,6 +950,14 @@ class TopKServer:
         try:
             d, i = self.index.query_topk(arr, self.m, tile=pad_to)
         except BaseException as e:
+            # the exception reaches every caller through its future, but
+            # an unobserved future would swallow it silently — record the
+            # failed dispatch on the telemetry spine too (ISSUE r10 audit)
+            telemetry.registry().counter_inc("serve.topk.errors")
+            telemetry.emit(
+                EVENTS.SERVE_TOPK_ERROR, error=repr(e), rows=int(n),
+                requests=len(batch), m=int(self.m),
+            )
             for _, fut in batch:
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(e)
@@ -931,7 +972,7 @@ class TopKServer:
         telemetry.registry().gauge_set("serve.topk.batch_rows", n)
         if telemetry.enabled():
             telemetry.emit(
-                "serve.topk_batch", rows=int(n), padded=int(pad_to),
+                EVENTS.SERVE_TOPK_BATCH, rows=int(n), padded=int(pad_to),
                 requests=len(batch), m=int(self.m),
                 wall_s=round(wall, 6),
             )

@@ -67,7 +67,9 @@ def _emit_hash_batch(path: str, n_tokens: int,
     resolved to (0 = the kernel's hardware-concurrency default).  The
     python path is the no-compiler fallback — a stream quietly riding it
     is the silent 10× ingest regression this event exists to expose."""
-    telemetry.registry().counter_inc(f"hash.batches.{path}")
+    telemetry.registry().counter_inc(
+        telemetry.EVENTS.HASH_BATCHES_FAMILY + path
+    )
     if telemetry.enabled():
         threads = _requested_threads(n_threads)
         if not threads:
@@ -79,7 +81,7 @@ def _emit_hash_batch(path: str, n_tokens: int,
             except ValueError:
                 threads = 0
         telemetry.emit(
-            "hash.batch", path=path, tokens=int(n_tokens),
+            telemetry.EVENTS.HASH_BATCH, path=path, tokens=int(n_tokens),
             threads=threads, native=load_murmur3() is not None,
             **telemetry.trace_fields(),
         )

@@ -366,7 +366,7 @@ def record_vmem_oom_retry(shape, mxu_mode: str, n_components: int) -> None:
 
     telemetry.registry().counter_inc("backend.vmem_oom_retries")
     telemetry.emit(
-        "backend.vmem_oom_retry", shape=list(shape),
+        telemetry.EVENTS.BACKEND_VMEM_OOM_RETRY, shape=list(shape),
         mxu_mode=mxu_mode, n_components=n_components,
         **telemetry.trace_fields(),
     )

@@ -59,6 +59,7 @@ import numpy as np
 import scipy.sparse as sp
 
 from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
 from randomprojection_tpu.utils.observability import (
     annotate,
     batch_nbytes,
@@ -456,7 +457,7 @@ class PrefetchSource(RowBatchSource):
                             # (consumer-bound)
                             self.stats.on_queue_depth(depth_now)
                         telemetry.emit(
-                            "stream.prefetch.deliver", row=int(lo),
+                            EVENTS.STREAM_PREFETCH_DELIVER, row=int(lo),
                             queue_depth=int(depth_now), capacity=self.depth,
                             **(
                                 {"trace_id": root.trace_id}
@@ -475,7 +476,7 @@ class PrefetchSource(RowBatchSource):
                     produced.close()
                 _put(self._DONE)
             except BaseException as e:  # propagate to the consumer thread
-                telemetry.emit("stream.prefetch.error", error=repr(e))
+                telemetry.emit(EVENTS.STREAM_PREFETCH_ERROR, error=repr(e))
                 _put((self._DONE, e))
 
         worker = threading.Thread(
@@ -530,7 +531,7 @@ class PrefetchSource(RowBatchSource):
                     "(inner source read or prepare() appears hung); "
                     "abandoning the daemon thread"
                 )
-                telemetry.emit("stream.prefetch.shutdown_timeout")
+                telemetry.emit(EVENTS.STREAM_PREFETCH_SHUTDOWN_TIMEOUT)
 
 
 class StagedIngestSource(RowBatchSource):
@@ -697,7 +698,7 @@ class StagedIngestSource(RowBatchSource):
                         return
             except BaseException as e:
                 telemetry.emit(
-                    "stream.staged.error", stage="hash", worker=w,
+                    EVENTS.STREAM_STAGED_ERROR, stage="hash", worker=w,
                     error=repr(e),
                 )
                 _put(worker_qs[w], (self._DONE, e))
@@ -737,7 +738,7 @@ class StagedIngestSource(RowBatchSource):
                     if self.stats is not None:
                         self.stats.on_queue_depth(depth_now)
                     telemetry.emit(
-                        "stream.staged.deliver", row=int(lo),
+                        EVENTS.STREAM_STAGED_DELIVER, row=int(lo),
                         queue_depth=int(depth_now), capacity=self.depth,
                         workers=n_workers,
                         **(
@@ -755,7 +756,7 @@ class StagedIngestSource(RowBatchSource):
                 _put(out_q, self._DONE)
             except BaseException as e:
                 telemetry.emit(
-                    "stream.staged.error", stage="upload", error=repr(e)
+                    EVENTS.STREAM_STAGED_ERROR, stage="upload", error=repr(e)
                 )
                 _put(out_q, (self._DONE, e))
 
@@ -815,7 +816,7 @@ class StagedIngestSource(RowBatchSource):
                     "shutdown (inner source read or prepare() appears "
                     "hung); abandoning the daemon thread(s)"
                 )
-                telemetry.emit("stream.staged.shutdown_timeout")
+                telemetry.emit(EVENTS.STREAM_STAGED_SHUTDOWN_TIMEOUT)
 
 
 @dataclasses.dataclass
@@ -957,8 +958,8 @@ def stream_transform(
                         "must expose one or the other"
                     )
                 telemetry.emit(
-                    "stream.dispatch", row=int(start_row), rows=int(n_rows),
-                    **telemetry.trace_fields(),
+                    EVENTS.STREAM_DISPATCH, row=int(start_row),
+                    rows=int(n_rows), **telemetry.trace_fields(),
                 )
             fetch_async = getattr(y, "copy_to_host_async", None)
             if fetch_async is not None:

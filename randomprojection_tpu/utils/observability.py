@@ -20,7 +20,7 @@ import time
 from typing import Optional
 
 from randomprojection_tpu.utils import telemetry
-from randomprojection_tpu.utils.telemetry import MetricsRegistry
+from randomprojection_tpu.utils.telemetry import EVENTS, MetricsRegistry
 
 logger = logging.getLogger("randomprojection_tpu")
 
@@ -167,7 +167,7 @@ class StreamStats:
         r.counter_inc("stream.bytes_in", bytes_in)
         r.counter_inc("stream.bytes_out", out_bytes)
         telemetry.emit(
-            "stream.commit", row=int(start_row), rows=int(n),
+            EVENTS.STREAM_COMMIT, row=int(start_row), rows=int(n),
             bytes_in=int(bytes_in), bytes_out=int(out_bytes),
             **telemetry.trace_fields(),
         )
@@ -193,7 +193,9 @@ class StreamStats:
             finally:
                 dt = time.perf_counter() - t0
                 self.registry.observe("stage." + name, dt)
-                telemetry.emit("stage.wall", stage=name, wall_s=round(dt, 6))
+                telemetry.emit(
+                    EVENTS.STAGE_WALL, stage=name, wall_s=round(dt, 6)
+                )
 
     def on_queue_depth(self, depth: int) -> None:
         """Record one prefetch-queue occupancy sample (taken by the

@@ -28,7 +28,9 @@ Event schema — every line is a JSON object with:
 
 - ``v``     int, schema version (this writer emits 2; readers accept 1-2)
 - ``ts``    float, unix seconds (``time.time()``)
-- ``event`` str, dotted event name (``stream.commit``,
+- ``event`` str, dotted event name — a member of the central ``EVENTS``
+  registry below (rplint rule RP02 keeps emit sites, the registry,
+  ``trace_report`` and the docs in agreement) (``stream.commit``,
   ``backend.dispatch``, ``backend.vmem_oom_retry``, ``stage.wall``,
   ``hash.batch``, ``simhash.query_tile``, ``simhash.topk_block_clamp``,
   ``simhash.topk_dense_fallback``, ``stream.prefetch.deliver``, ...)
@@ -80,6 +82,8 @@ from typing import Iterator, Optional
 __all__ = [
     "SCHEMA_VERSION",
     "SUPPORTED_SCHEMA_VERSIONS",
+    "EVENTS",
+    "registered_event",
     "MetricsRegistry",
     "TelemetryLog",
     "configure",
@@ -103,6 +107,87 @@ SCHEMA_VERSION = 2
 # readers accept every version whose events they can represent; v1 files
 # (committed telemetry fixtures, old runs) parse forever
 SUPPORTED_SCHEMA_VERSIONS = frozenset({1, 2})
+
+
+class EVENTS:
+    """Central registry of every telemetry event name (ISSUE r10).
+
+    Before this class existed the event namespace lived in string
+    literals scattered across seven modules, kept in agreement with
+    ``trace_report.py`` and the docs by code review alone — exactly the
+    emitter/consumer drift nothing guarded.  The contract, enforced by
+    ``analysis/rplint.py`` rule RP02 (``cli lint``, run by
+    ``make verify``):
+
+    - every statically-resolvable name passed to ``emit()`` anywhere in
+      the package MUST be a member here (emit sites reference the
+      constants, never fresh literals);
+    - every member MUST be either consumed by ``utils/trace_report.py``
+      or documented in docs/ARCHITECTURE.md's event table — an event
+      nobody reads and nobody documents is drift, and fails the lint.
+
+    ``FAMILIES`` registers dotted-name *prefixes* for names completed at
+    runtime (f-string emits and the per-path metric families such as the
+    ``hash.batches.<path>`` counters); ``registered_event()`` accepts a
+    name when it is a member or extends a family.  ``trace_report``'s
+    degraded-event audit warns on any event in a telemetry file that the
+    registry it was built against does not know.
+    """
+
+    # tracing span pair (schema v2) — emitted ONLY by this module
+    SPAN_START = "span_start"
+    SPAN_END = "span_end"
+    # streaming pipeline
+    STAGE_WALL = "stage.wall"
+    STREAM_COMMIT = "stream.commit"
+    STREAM_DISPATCH = "stream.dispatch"
+    STREAM_PREFETCH_DELIVER = "stream.prefetch.deliver"
+    STREAM_PREFETCH_ERROR = "stream.prefetch.error"
+    STREAM_PREFETCH_SHUTDOWN_TIMEOUT = "stream.prefetch.shutdown_timeout"
+    STREAM_STAGED_DELIVER = "stream.staged.deliver"
+    STREAM_STAGED_ERROR = "stream.staged.error"
+    STREAM_STAGED_SHUTDOWN_TIMEOUT = "stream.staged.shutdown_timeout"
+    # backend dispatch + degraded retries
+    BACKEND_DISPATCH = "backend.dispatch"
+    BACKEND_VMEM_OOM_RETRY = "backend.vmem_oom_retry"
+    # ingest hashing
+    HASH_BATCH = "hash.batch"
+    # simhash query/serving
+    SIMHASH_QUERY_TILE = "simhash.query_tile"
+    SIMHASH_TOPK_TILE = "simhash.topk_tile"
+    SIMHASH_TOPK_BLOCK_CLAMP = "simhash.topk_block_clamp"
+    SIMHASH_TOPK_DENSE_FALLBACK = "simhash.topk_dense_fallback"
+    SERVE_TOPK_BATCH = "serve.topk_batch"
+    SERVE_TOPK_ERROR = "serve.topk.error"
+
+    # runtime-completed name families.  ``*_FAMILY`` constants are the
+    # prefixes callers build on (today: the per-kernel-path hash counter
+    # family, ``hash.batches.strided`` / ``.list`` / ``.python``);
+    # FAMILIES is the tuple ``registered_event`` prefix-matches against.
+    HASH_BATCHES_FAMILY = "hash.batches."
+    FAMILIES = (HASH_BATCHES_FAMILY,)
+
+
+def _event_names() -> frozenset:
+    return frozenset(
+        v
+        for k, v in vars(EVENTS).items()
+        if k.isupper()
+        and not k.endswith("_FAMILY")
+        and k != "FAMILIES"
+        and isinstance(v, str)
+    )
+
+
+_EVENT_NAMES = _event_names()
+
+
+def registered_event(name: str) -> bool:
+    """True when ``name`` is a registered event: an ``EVENTS`` member or
+    an extension of a registered family prefix."""
+    return name in _EVENT_NAMES or any(
+        name.startswith(f) for f in EVENTS.FAMILIES
+    )
 
 
 class MetricsRegistry:
@@ -385,6 +470,7 @@ def _finalizing() -> bool:
     at that point must drop the event, never traceback."""
     try:
         return sys is None or sys.is_finalizing()
+    # rplint: allow[RP06] — teardown probe: the failure IS the answer
     except Exception:  # pragma: no cover — modules already demolished
         return True
 
@@ -487,8 +573,8 @@ def start_span(name: str, *, parent: Optional[Span] = None,
             trace_id, parent_id = parent.trace_id, parent.span_id
         s = Span(name, trace_id, span_id, parent_id, time.perf_counter())
         emit(
-            "span_start", name=name, trace_id=trace_id, span_id=span_id,
-            parent_id=parent_id, **attrs,
+            EVENTS.SPAN_START, name=name, trace_id=trace_id,
+            span_id=span_id, parent_id=parent_id, **attrs,
         )
         return s
     except Exception:
@@ -505,7 +591,7 @@ def end_span(span_: Optional[Span], **attrs) -> None:
         return
     try:
         emit(
-            "span_end", name=span_.name, trace_id=span_.trace_id,
+            EVENTS.SPAN_END, name=span_.name, trace_id=span_.trace_id,
             span_id=span_.span_id,
             dur_s=round(time.perf_counter() - span_.t0, 9), **attrs,
         )

@@ -38,21 +38,28 @@ from __future__ import annotations
 
 from typing import Optional
 
-from randomprojection_tpu.utils.telemetry import read_events
+from randomprojection_tpu.utils.telemetry import (
+    EVENTS,
+    read_events,
+    registered_event,
+)
 
 __all__ = ["build_report", "render_report", "DEGRADED_EVENTS"]
 
 # event names that mark a degraded execution path; the audit reports a
 # count for each even when zero, so "nothing degraded" is an explicit
-# statement, not an absence
+# statement, not an absence.  Names come from the central registry
+# (telemetry.EVENTS) — rplint rule RP02 counts a registry entry named
+# here as "consumed", closing the emitter/consumer drift loop.
 DEGRADED_EVENTS = (
-    "backend.vmem_oom_retry",
-    "simhash.topk_dense_fallback",
-    "simhash.topk_block_clamp",
-    "stream.prefetch.error",
-    "stream.prefetch.shutdown_timeout",
-    "stream.staged.error",
-    "stream.staged.shutdown_timeout",
+    EVENTS.BACKEND_VMEM_OOM_RETRY,
+    EVENTS.SIMHASH_TOPK_DENSE_FALLBACK,
+    EVENTS.SIMHASH_TOPK_BLOCK_CLAMP,
+    EVENTS.STREAM_PREFETCH_ERROR,
+    EVENTS.STREAM_PREFETCH_SHUTDOWN_TIMEOUT,
+    EVENTS.STREAM_STAGED_ERROR,
+    EVENTS.STREAM_STAGED_SHUTDOWN_TIMEOUT,
+    EVENTS.SERVE_TOPK_ERROR,
 )
 
 
@@ -134,12 +141,12 @@ def build_report(path: str) -> dict:
         n_events += 1
         name = e["event"]
         event_counts[name] = event_counts.get(name, 0) + 1
-        if name == "span_start":
+        if name == EVENTS.SPAN_START:
             if "span_id" not in e or "trace_id" not in e:
                 malformed_spans += 1
                 continue
             starts[e["span_id"]] = e
-        elif name == "span_end":
+        elif name == EVENTS.SPAN_END:
             if "span_id" not in e:
                 malformed_spans += 1
                 continue
@@ -179,14 +186,16 @@ def build_report(path: str) -> dict:
                 stage_total[k] = stage_total.get(k, 0.0) + v
             bubble_total += bubble
             wall_total += wall
-        elif name in ("stream.prefetch.deliver", "stream.staged.deliver"):
+        elif name in (
+            EVENTS.STREAM_PREFETCH_DELIVER, EVENTS.STREAM_STAGED_DELIVER
+        ):
             d = e.get("queue_depth", 0)
             queue_n += 1
             queue_max = max(queue_max, d)
             queue_sum += d
             if queue_capacity is None:
                 queue_capacity = e.get("capacity")
-        elif name == "hash.batch" and e.get("path") == "python":
+        elif name == EVENTS.HASH_BATCH and e.get("path") == "python":
             hash_python += 1
 
     # traces whose root never ended: their buffered children are orphaned
@@ -206,6 +215,15 @@ def build_report(path: str) -> dict:
     )
     degraded = {name: event_counts.get(name, 0) for name in DEGRADED_EVENTS}
     degraded["hash.batch[path=python]"] = hash_python
+    # emitter/consumer drift guard: event names this registry version
+    # does not know (an emitter ahead of the registry, a file from a
+    # newer build, or a stray literal that dodged the lint) — surfaced
+    # in the degraded-event audit rather than silently counted
+    unregistered = {
+        name: c
+        for name, c in sorted(event_counts.items())
+        if not registered_event(name)
+    }
     queue = None
     if queue_n:
         queue = {
@@ -247,6 +265,7 @@ def build_report(path: str) -> dict:
         },
         "queue_depth": queue,
         "degraded": degraded,
+        "unregistered_events": unregistered,
     }
 
 
@@ -319,6 +338,14 @@ def render_report(report: dict) -> str:
             if worst else "no degraded paths recorded"
         )
     )
+    unreg = report.get("unregistered_events")
+    if unreg:
+        lines.append(
+            "  WARNING: event name(s) not in the telemetry.EVENTS "
+            "registry this report was built against:"
+        )
+        for k, v in sorted(unreg.items()):
+            lines.append(f"    {k:<34} {v}")
     tw = report.get("tripwire")
     if tw is not None:
         lines.append("")

@@ -1,0 +1,5 @@
+"""RP00 fixture: malformed pragmas (each line below is one finding)."""
+
+X = 1  # rplint: allow[RP03]
+Y = 2  # rplint: allowing things informally
+Z = 3  # rplint: allow[RP99] — no such rule

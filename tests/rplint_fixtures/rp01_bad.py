@@ -1,0 +1,45 @@
+"""RP01 fixture: unbalanced spans and a hand-rolled span event."""
+from randomprojection_tpu.utils import telemetry
+
+
+def do_work():
+    pass
+
+
+def leaky():
+    # straight-line end: the span leaks when do_work raises
+    s = telemetry.start_span("work")  # VIOLATION
+    do_work()
+    telemetry.end_span(s)
+
+
+def discarded():
+    telemetry.start_span("work")  # VIOLATION: handle discarded
+
+
+def handrolled():
+    telemetry.emit("span_start", name="fake")  # VIOLATION
+
+
+def suppressed_leak():
+    # rplint: allow[RP01] — fixture: suppression case
+    s = telemetry.start_span("work")
+    do_work()
+    telemetry.end_span(s)
+
+
+def balanced():
+    s = telemetry.start_span("work")
+    try:
+        do_work()
+    finally:
+        telemetry.end_span(s)
+
+
+def escaping_return():
+    return telemetry.start_span("work")
+
+
+def escaping_queue(q):
+    s = telemetry.start_span("work")
+    q.put((0, s))

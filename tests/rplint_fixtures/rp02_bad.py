@@ -1,0 +1,17 @@
+"""RP02 fixture: events off the registry (linted against a synthetic
+registry knowing only ``good.event`` and the ``fam.`` family)."""
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+
+def emits(x):
+    telemetry.emit("rogue.event", x=1)  # VIOLATION: unregistered literal
+    telemetry.emit(EVENTS.NOPE, x=1)  # VIOLATION: unknown constant
+    telemetry.emit(f"other.{x}", x=1)  # VIOLATION: unregistered family
+    telemetry.emit("good.event")  # ok
+    telemetry.emit(EVENTS.GOOD)  # ok
+    telemetry.emit(f"fam.{x}")  # ok
+    name = "dynamic"
+    telemetry.emit(name)  # ok: not statically resolvable
+    # rplint: allow[RP02] — fixture: suppression case
+    telemetry.emit("rogue.event2", x=1)  # suppressed

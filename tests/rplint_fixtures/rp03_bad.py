@@ -1,0 +1,18 @@
+"""RP03 fixture: per-iteration host syncs (linted under the virtual
+relpath ``streaming.py`` so the hot-module scoping applies)."""
+import jax
+import numpy as np
+
+
+def hot(handles, y):
+    out = []
+    for h in handles:
+        out.append(np.asarray(h))  # VIOLATION
+        y.block_until_ready()  # VIOLATION
+        v = float(y.sum())  # VIOLATION
+        g = jax.device_get(y)  # VIOLATION
+        # rplint: allow[RP03] — fixture: suppression case
+        out.append(np.asarray(h))  # suppressed
+    ok_outside = np.asarray(handles)  # ok: not inside a loop
+    ok_scalar = float(v)  # ok: float() on a plain name
+    return out, g, ok_outside, ok_scalar

@@ -1,0 +1,18 @@
+"""RP04 fixture: implicit daemon flag and unbounded queues (this module
+does contain a ``.join(``, so only those two classes fire)."""
+import queue
+import threading
+
+
+def spawn():
+    t = threading.Thread(target=print)  # VIOLATION: no daemon=
+    q = queue.Queue()  # VIOLATION: unbounded
+    bounded = queue.Queue(maxsize=2)  # ok
+    t2 = threading.Thread(target=print, daemon=True)  # ok
+    t.start()
+    t2.start()
+    t.join()
+    t2.join()
+    # rplint: allow[RP04] — fixture: suppression case
+    q2 = queue.Queue()  # suppressed
+    return q, bounded, q2

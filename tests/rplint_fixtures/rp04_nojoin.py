@@ -1,0 +1,5 @@
+"""RP04 fixture: a module that starts a thread and never joins one."""
+import threading
+
+t = threading.Thread(target=print, daemon=True)  # VIOLATION: no .join(
+t.start()

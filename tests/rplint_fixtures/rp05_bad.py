@@ -1,0 +1,18 @@
+"""RP05 fixture: clocks and hidden-global RNG (linted under the virtual
+relpath ``ops/fixture.py`` so the determinism scoping applies)."""
+import random
+import time
+
+import numpy as np
+
+
+def kernel(n):
+    t = time.time()  # VIOLATION
+    a = random.random()  # VIOLATION
+    b = np.random.rand(n)  # VIOLATION
+    rng = np.random.default_rng(0)  # ok: Generator construction
+    c = rng.normal(size=n)
+    t2 = time.perf_counter()  # ok
+    # rplint: allow[RP05] — fixture: suppression case
+    d = np.random.rand(n)  # suppressed
+    return t, a, b, c, t2, d

@@ -1,0 +1,39 @@
+"""RP06 fixture: silently-swallowed broad handlers (linted under the
+virtual relpath ``streaming.py`` so the pipeline scoping applies)."""
+from randomprojection_tpu.utils import telemetry
+
+
+def swallow(fn):
+    try:
+        fn()
+    except Exception:  # VIOLATION
+        pass
+
+
+def swallow_suppressed(fn):
+    try:
+        fn()
+    # rplint: allow[RP06] — fixture: suppression case
+    except Exception:
+        pass
+
+
+def ok_reraise(fn):
+    try:
+        fn()
+    except Exception:
+        raise
+
+
+def ok_emit(fn):
+    try:
+        fn()
+    except Exception as e:
+        telemetry.emit("x.error", error=repr(e))
+
+
+def ok_narrow(fn):
+    try:
+        fn()
+    except ValueError:  # narrow handlers are the caller's business
+        pass

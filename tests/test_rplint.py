@@ -1,0 +1,307 @@
+"""rplint (ISSUE r10): every rule against its known-bad fixture, the
+pragma grammar, the registry drift check, the stable --json schema, and
+— the acceptance gate — that the shipped tree lints clean through the
+real `cli lint` entry point."""
+
+import json
+import os
+
+import pytest
+
+from randomprojection_tpu import cli
+from randomprojection_tpu.analysis import rplint
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "rplint_fixtures")
+
+
+def _lint_fixture(name, relpath=None, registry=None):
+    with open(os.path.join(FIXTURES, name)) as f:
+        src = f.read()
+    return rplint.lint_source(src, relpath or name, registry=registry)
+
+
+def _split(findings):
+    return (
+        [f for f in findings if not f.suppressed],
+        [f for f in findings if f.suppressed],
+    )
+
+
+# -- per-rule fixtures -------------------------------------------------------
+
+
+def test_rp00_malformed_pragmas():
+    active, suppressed = _split(_lint_fixture("rp00_bad.py"))
+    assert [f.rule for f in active] == ["RP00", "RP00", "RP00"]
+    assert not suppressed  # pragma hygiene is not suppressible
+    msgs = " | ".join(f.message for f in active)
+    assert "reason required" in msgs and "unknown rule" in msgs
+
+
+def test_rp01_span_balance():
+    active, suppressed = _split(_lint_fixture("rp01_bad.py"))
+    assert [f.rule for f in active] == ["RP01", "RP01", "RP01"]
+    # straight-line end, discarded handle, hand-rolled span event —
+    # and nothing from the balanced/escaping functions
+    msgs = [f.message for f in active]
+    assert sum("neither escapes" in m for m in msgs) == 2
+    assert sum("span event" in m for m in msgs) == 1
+    assert [f.rule for f in suppressed] == ["RP01"]
+    assert suppressed[0].reason.startswith("fixture:")
+
+
+def test_rp02_event_registry():
+    reg = rplint.EventRegistry(
+        events={"GOOD": "good.event"}, families=("fam.",), lines={}
+    )
+    active, suppressed = _split(
+        _lint_fixture("rp02_bad.py", registry=reg)
+    )
+    assert [f.rule for f in active] == ["RP02", "RP02", "RP02"]
+    msgs = " | ".join(f.message for f in active)
+    assert "'rogue.event'" in msgs
+    assert "EVENTS.NOPE" in msgs
+    assert "'other.'" in msgs
+    assert [f.rule for f in suppressed] == ["RP02"]
+    # without a registry (standalone file lint) the rule stays silent
+    assert _lint_fixture("rp02_bad.py", registry=None) == []
+
+
+def test_rp03_hot_path_host_syncs():
+    active, suppressed = _split(
+        _lint_fixture("rp03_bad.py", relpath="streaming.py")
+    )
+    assert [f.rule for f in active] == ["RP03"] * 4
+    msgs = " | ".join(f.message for f in active)
+    for probe in ("np.asarray", "block_until_ready", "float()",
+                  "jax.device_get"):
+        assert probe in msgs
+    assert [f.rule for f in suppressed] == ["RP03"]
+    # the same code outside a hot module is not RP03's business
+    assert _lint_fixture("rp03_bad.py") == []
+
+
+def test_rp04_thread_hygiene():
+    active, suppressed = _split(_lint_fixture("rp04_bad.py"))
+    assert [f.rule for f in active] == ["RP04", "RP04"]
+    msgs = " | ".join(f.message for f in active)
+    assert "daemon=" in msgs and "unbounded" in msgs
+    assert [f.rule for f in suppressed] == ["RP04"]
+
+    nojoin = _lint_fixture("rp04_nojoin.py")
+    assert [f.rule for f in nojoin] == ["RP04"]
+    assert "no .join(" in nojoin[0].message
+
+
+def test_rp05_determinism_in_ops():
+    active, suppressed = _split(
+        _lint_fixture("rp05_bad.py", relpath="ops/fixture.py")
+    )
+    assert [f.rule for f in active] == ["RP05"] * 3
+    msgs = " | ".join(f.message for f in active)
+    assert "time.time()" in msgs
+    assert "random.random()" in msgs
+    assert "np.random.rand" in msgs
+    assert [f.rule for f in suppressed] == ["RP05"]
+    assert _lint_fixture("rp05_bad.py") == []  # outside ops/: silent
+
+
+def test_rp06_silent_swallow():
+    active, suppressed = _split(
+        _lint_fixture("rp06_bad.py", relpath="streaming.py")
+    )
+    assert [f.rule for f in active] == ["RP06"]
+    assert "swallows" in active[0].message
+    assert [f.rule for f in suppressed] == ["RP06"]
+    assert _lint_fixture("rp06_bad.py") == []  # outside the pipeline set
+
+
+def test_rp04_zero_and_negative_maxsize_are_unbounded():
+    """Python treats any maxsize <= 0 as unbounded — every spelling of
+    that must trip RP04, not just the bare constructor."""
+    for spelling in ("queue.Queue()", "queue.Queue(0)",
+                     "queue.Queue(maxsize=0)", "queue.Queue(maxsize=-1)"):
+        fs = rplint.lint_source(f"import queue\nq = {spelling}\n", "x.py")
+        assert [f.rule for f in fs] == ["RP04"], spelling
+    ok = rplint.lint_source(
+        "import queue\nq = queue.Queue(maxsize=8)\n", "x.py"
+    )
+    assert ok == []
+
+
+def test_pragma_with_any_unknown_rule_suppresses_nothing():
+    """allow[RP04,RP99] is void in full: the RP04 finding stays active
+    (plus the RP00 for the typo) — a typo can never accept a
+    violation."""
+    src = (
+        "import queue\n"
+        "# rplint: allow[RP04,RP99] — typo'd rule voids the pragma\n"
+        "q = queue.Queue()\n"
+    )
+    fs = rplint.lint_source(src, "x.py")
+    assert {f.rule for f in fs if not f.suppressed} == {"RP00", "RP04"}
+    assert not [f for f in fs if f.suppressed]
+
+
+def test_drift_check_requires_the_repo_doc(tmp_path):
+    """Installed layout (no docs/ next to the package): the drift check
+    stands down instead of flagging every documented-only event; the
+    repo layout (doc present) enforces it."""
+    pkg = tmp_path / "pkg"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "utils" / "telemetry.py").write_text(
+        "class EVENTS:\n    ROGUE = 'rogue.event'\n    FAMILIES = ()\n"
+    )
+    (pkg / "utils" / "trace_report.py").write_text("# consumes nothing\n")
+    rep = rplint.lint_package(root=str(pkg))
+    assert rep["ok"] is True  # no doc on disk: drift leg skipped
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "ARCHITECTURE.md").write_text("nothing here\n")
+    rep2 = rplint.lint_package(root=str(pkg))
+    assert rep2["ok"] is False
+    assert rep2["counts"] == {"RP02": 1}
+    assert "rogue.event" in rep2["findings"][-1]["message"]
+
+
+# -- registry drift ----------------------------------------------------------
+
+
+def test_registry_drift_check():
+    reg = rplint.EventRegistry(
+        events={"A": "a.event", "B": "b.event", "C": "c.event"},
+        families=(),
+        lines={"A": 10, "B": 11, "C": 12},
+    )
+    findings = rplint.check_registry_drift(
+        reg,
+        consumer_text="... reads EVENTS.A and also 'b.event' ...",
+        doc_text="only c.event is documented here",
+    )
+    # A consumed by constant reference, B by literal, C documented
+    assert findings == []
+    findings = rplint.check_registry_drift(
+        reg, consumer_text="EVENTS.A", doc_text=""
+    )
+    assert [(f.rule, f.line) for f in findings] == [
+        ("RP02", 11), ("RP02", 12)
+    ]
+    assert "neither consumed" in findings[0].message
+
+
+def test_real_registry_parses_statically():
+    with open(os.path.join(
+        rplint.package_root(), "utils", "telemetry.py"
+    )) as f:
+        reg = rplint.load_event_registry(f.read())
+    assert reg is not None
+    assert "stream.commit" in reg.events.values()
+    assert "span_start" in reg.events.values()
+    assert "hash.batches." in reg.families
+    # the static parse agrees with the live module
+    from randomprojection_tpu.utils import telemetry
+
+    assert set(reg.events.values()) == set(telemetry._EVENT_NAMES)
+    assert reg.families == telemetry.EVENTS.FAMILIES
+
+
+# -- the shipped tree (acceptance gate) --------------------------------------
+
+
+def test_shipped_tree_lints_clean():
+    """`cli lint` exits 0 on the repo at merge time — the tentpole's
+    acceptance criterion.  Every suppression in the tree must carry a
+    reason (the pragma grammar guarantees it; assert anyway)."""
+    report = rplint.lint_package()
+    bad = [f for f in report["findings"] if not f["suppressed"]]
+    assert report["ok"], "rplint findings on the shipped tree:\n" + "\n".join(
+        "%s:%s: %s %s" % (f["path"], f["line"], f["rule"], f["message"])
+        for f in bad
+    )
+    assert all(
+        f["reason"] for f in report["findings"] if f["suppressed"]
+    )
+    assert report["files"] >= 30  # the walk saw the whole package
+
+
+def test_cli_lint_exits_zero_and_json_schema(capsys):
+    assert cli.main(["lint"]) == 0
+    capsys.readouterr()
+    assert cli.main(["lint", "--json"]) == 0
+    out = capsys.readouterr().out.strip()
+    rec = json.loads(out)
+    assert rec["rplint"] == 1 and rec["ok"] is True
+    assert set(rec) == {
+        "rplint", "root", "files", "findings", "counts", "suppressed", "ok"
+    }
+    for f in rec["findings"]:  # the suppressed ones in the tree
+        assert set(f) == {
+            "rule", "path", "line", "message", "suppressed", "reason"
+        }
+        assert f["suppressed"] is True
+
+
+def test_cli_lint_seeded_violation(tmp_path, capsys):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "import queue\nimport threading\n\n"
+        "q = queue.Queue()\n"
+        "t = threading.Thread(target=print)\n"
+        "t.start()\n"
+    )
+    assert cli.main(["lint", str(bad)]) == 1
+    capsys.readouterr()
+    assert cli.main(["lint", "--json", str(bad)]) == 1
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["ok"] is False
+    rules = {f["rule"] for f in rec["findings"]}
+    assert rules == {"RP04"}
+    assert rec["counts"]["RP04"] == 3  # unbounded q, no daemon=, no join
+    # a pragma with a reason suppresses it, restoring exit 0
+    bad.write_text(
+        "import queue\n\n"
+        "# rplint: allow[RP04] — test: bounded by construction elsewhere\n"
+        "q = queue.Queue()\n"
+    )
+    capsys.readouterr()
+    assert cli.main(["lint", str(bad)]) == 0
+
+
+# -- trace_report's registry-drift warning (ISSUE r10 satellite) -------------
+
+
+def test_trace_report_warns_on_unregistered_events(tmp_path):
+    from randomprojection_tpu.utils import telemetry
+    from randomprojection_tpu.utils.trace_report import (
+        build_report,
+        render_report,
+    )
+
+    p = str(tmp_path / "t.jsonl")
+    telemetry.configure(p)
+    telemetry.emit(telemetry.EVENTS.STREAM_COMMIT, row=0, rows=1)
+    telemetry.emit("totally.unknown", x=1)
+    telemetry.emit(telemetry.EVENTS.HASH_BATCHES_FAMILY + "strided")
+    telemetry.shutdown()
+    report = build_report(p)
+    assert report["unregistered_events"] == {"totally.unknown": 1}
+    text = render_report(report)
+    assert "not in the telemetry.EVENTS registry" in text
+    assert "totally.unknown" in text
+
+    # a clean file keeps the audit quiet
+    p2 = str(tmp_path / "clean.jsonl")
+    telemetry.configure(p2)
+    telemetry.emit(telemetry.EVENTS.STREAM_COMMIT, row=0, rows=1)
+    telemetry.shutdown()
+    r2 = build_report(p2)
+    assert r2["unregistered_events"] == {}
+    assert "not in the telemetry.EVENTS registry" not in render_report(r2)
+
+
+def test_registered_event_families():
+    from randomprojection_tpu.utils import telemetry
+
+    assert telemetry.registered_event("stream.commit")
+    assert telemetry.registered_event("hash.batches.python")
+    assert not telemetry.registered_event("hash.batch.python")
+    assert not telemetry.registered_event("made.up")

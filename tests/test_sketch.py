@@ -536,6 +536,69 @@ def test_topk_server_lifecycle_and_validation():
     ]
 
 
+def test_topk_server_bounded_queue_rejects_when_stalled():
+    """The submit queue is bounded (ISSUE r10): with the dispatcher not
+    draining, the max_pending+1'th submit fails fast instead of growing
+    host memory — and close() still never blocks (the sentinel slot is
+    reserved past the bound)."""
+    from randomprojection_tpu.models.sketch import TopKServer
+
+    idx, q = _serving_fixture(n_codes=200, n_add=0, nq=8)
+    with pytest.raises(ValueError, match="max_pending"):
+        TopKServer(idx, 2, max_pending=0)
+    # start=False = a permanently stalled dispatcher
+    srv = TopKServer(idx, 2, max_pending=2, start=False)
+    f1 = srv.submit(q[:1])
+    f2 = srv.submit(q[:1])
+    with pytest.raises(RuntimeError, match="queue is full"):
+        srv.submit(q[:1])
+    from randomprojection_tpu.utils import telemetry
+
+    assert telemetry.registry().counter("serve.topk.rejects") >= 1
+    srv.close()  # sentinel fits in the reserved slot: returns immediately
+    assert not f1.done() and not f2.done()  # never served: stalled drain
+
+
+def test_topk_server_failed_dispatch_emits_error_event(tmp_path):
+    """A coalesced dispatch that fails on device reaches every caller
+    through its future AND the telemetry spine (serve.topk.error +
+    serve.topk.errors counter) — ISSUE r10's silent-swallow audit."""
+    from randomprojection_tpu.models.sketch import TopKServer
+    from randomprojection_tpu.utils import telemetry
+
+    idx, q = _serving_fixture(n_codes=200, n_add=0, nq=8)
+    srv = TopKServer(idx, 2, start=False)
+    srv.index = _Boom(idx)
+    tel = str(tmp_path / "serve.jsonl")
+    telemetry.configure(tel)
+    try:
+        srv.start()
+        fut = srv.submit(q[:4])
+        with pytest.raises(RuntimeError, match="device exploded"):
+            fut.result(timeout=60)
+        srv.close()
+    finally:
+        telemetry.shutdown()
+    evs = [e for e in telemetry.read_events(tel)
+           if e["event"] == "serve.topk.error"]
+    assert len(evs) == 1
+    assert evs[0]["requests"] == 1 and "device exploded" in evs[0]["error"]
+    assert telemetry.registry().counter("serve.topk.errors") >= 1
+
+
+class _Boom:
+    """Index stand-in whose query_topk always fails on 'device'."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def query_topk(self, *a, **k):
+        raise RuntimeError("device exploded")
+
+
 def test_topk_bench_composition(monkeypatch):
     """The config-4 serving bench (single-stream + micro-batched modes)
     runs end to end at toy shapes and records both rates with their own
