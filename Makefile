@@ -1,14 +1,20 @@
 # Repo verification entry points (ISSUE r8 satellite; r9 added the
 # staged-ingest leg; r10 the static-analysis gate).
 #
-#   make verify        rplint static analysis, then the tier-1 suite
-#                      (the ROADMAP.md command) + a doctor smoke run, so
-#                      the telemetry/report path cannot rot
+#   make verify        rplint static analysis, the crash-recovery smoke
+#                      (subprocess SIGKILL/resume fault matrix), then
+#                      the tier-1 suite (the ROADMAP.md command) + a
+#                      doctor smoke run, so the telemetry/report path
+#                      cannot rot
 #   make lint          rplint (analysis/rplint.py via `cli lint`): span
 #                      balance, event-registry drift, hot-path host
 #                      syncs, thread hygiene, ops/ determinism, silent
 #                      swallows — non-zero on any unsuppressed finding
 #   make tier1         just the test suite
+#   make recover-smoke subprocess kill/resume harness at toy shapes:
+#                      SIGKILL the durable ingest at every injected
+#                      point, restart, assert the recovered index is
+#                      bit-identical to an uninterrupted run (ISSUE 6)
 #   make doctor-smoke  generate real telemetry files via the CLI (a
 #                      single-worker run AND a staged --ingest-workers
 #                      run) and run `doctor` on them; asserts the staged
@@ -18,12 +24,18 @@ SHELL := /bin/bash
 PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
-.PHONY: verify lint tier1 doctor-smoke
+.PHONY: verify lint tier1 recover-smoke doctor-smoke
 
-verify: lint tier1 doctor-smoke
+verify: lint recover-smoke tier1 doctor-smoke
 
 lint:
 	$(PYTHON) -m randomprojection_tpu lint
+
+recover-smoke:
+	rm -rf $(SMOKE_DIR)_recover && mkdir -p $(SMOKE_DIR)_recover
+	JAX_PLATFORMS=cpu $(PYTHON) -m randomprojection_tpu recover --smoke \
+	  $(SMOKE_DIR)_recover
+	@echo "recover-smoke OK"
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \

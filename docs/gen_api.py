@@ -74,6 +74,7 @@ def main():
         else:
             lines += [f"### `{name}` — {type(obj).__name__}", ""]
 
+    import randomprojection_tpu.durable as durable
     import randomprojection_tpu.serialize as serialize
     import randomprojection_tpu.streaming as streaming
     import randomprojection_tpu.parallel as parallel
@@ -85,6 +86,7 @@ def main():
     for title, mod in [
         ("`randomprojection_tpu.streaming`", streaming),
         ("`randomprojection_tpu.serialize`", serialize),
+        ("`randomprojection_tpu.durable`", durable),
         ("`randomprojection_tpu.parallel`", parallel),
         ("`randomprojection_tpu.parallel.distributed`", distributed),
         ("`randomprojection_tpu.ops.hashing`", hashing),

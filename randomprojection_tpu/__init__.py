@@ -35,11 +35,14 @@ _LAZY_ESTIMATORS = (
     "topk_bruteforce",
 )
 
+_LAZY_DURABLE = ("DurableIngest", "save_index", "load_index")
+
 __all__ = [
     "johnson_lindenstrauss_min_dim",
     "DataDimensionalityWarning",
     "NotFittedError",
     *_LAZY_ESTIMATORS,
+    *_LAZY_DURABLE,
 ]
 
 
@@ -50,4 +53,8 @@ def __getattr__(name):
         from randomprojection_tpu import models
 
         return getattr(models, name)
+    if name in _LAZY_DURABLE:
+        from randomprojection_tpu import durable
+
+        return getattr(durable, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

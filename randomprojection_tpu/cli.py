@@ -11,6 +11,9 @@ SURVEY.md §6 config/flag system):
                     kept separate per SURVEY.md §7)
 - ``topk-bench``    SimHash top-k serving queries/s, direct vs the
                     ``TopKServer`` micro-batcher
+- ``recover``       durable index lifecycle: snapshot status + checksum
+                    verification, and the subprocess SIGKILL recovery
+                    smoke (``--smoke``)
 - ``doctor``        per-batch critical-path report from a telemetry
                     JSONL file (alias: ``report``) — stage waterfall,
                     bubbles, degraded-event audit, tripwire status
@@ -190,6 +193,43 @@ def build_parser():
                    help="emit the stable findings record as one JSON "
                         "object: rplint version, per-finding rule id / "
                         "path / line / message / pragma state, counts")
+
+    q = sub.add_parser(
+        "recover",
+        help="durable index lifecycle: snapshot status, checksum "
+             "verification, and the process-kill recovery smoke",
+        description="Inspect a durable SimHash index snapshot / ingest "
+                    "directory (durable.py): validate the manifest "
+                    "version, verify every chunk's SHA-256 payload "
+                    "checksum, check that chunk row ranges tile exactly "
+                    "once, and list orphan spill files a crash left "
+                    "behind — JSON status on stdout, non-zero exit on "
+                    "corruption.  --smoke instead runs the subprocess "
+                    "SIGKILL fault matrix at toy shapes (kill at "
+                    "mid-batch, post-yield pre-ack and "
+                    "mid-snapshot-rename; restart; assert the recovered "
+                    "index is bit-identical to an uninterrupted run).",
+    )
+    q.add_argument("dir", nargs="?", metavar="DIR",
+                   help="snapshot / durable-ingest directory to inspect")
+    q.add_argument("--smoke", action="store_true",
+                   help="run the crash-recovery fault matrix in a "
+                        "temporary directory (or DIR when given) and "
+                        "exit non-zero unless every kill point recovers "
+                        "bit-identically")
+    # harness child entry: one deterministic toy ingest into DIR,
+    # honoring RP_DURABLE_KILL kill points (used by --smoke and tests)
+    q.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    q.add_argument("--rows", type=_positive_int, default=192,
+                   help="harness rows (child/smoke)")
+    q.add_argument("--batch-rows", type=_positive_int, default=32,
+                   help="harness rows per batch (child/smoke)")
+    q.add_argument("--d", type=_positive_int, default=16,
+                   help="harness input dimension (child/smoke)")
+    q.add_argument("--bits", type=_positive_int, default=64,
+                   help="harness SimHash code bits (child/smoke)")
+    q.add_argument("--seed", type=int, default=0)
+    _add_observability(q)
 
     q = sub.add_parser(
         "topk-bench",
@@ -513,6 +553,47 @@ def cmd_lint(args):
     return rplint.main(argv)
 
 
+def cmd_recover(args):
+    """Durable-lifecycle operations (see ``durable.py``): snapshot
+    status + checksum verification (default), the subprocess SIGKILL
+    recovery smoke (``--smoke``), and the deterministic harness child
+    ingest (``--child``, used by the smoke and the test suite)."""
+    import tempfile
+
+    from randomprojection_tpu import durable
+
+    if args.child:
+        if not args.dir:
+            raise SystemExit("recover --child requires DIR")
+        summary = durable.demo_ingest(
+            args.dir, rows=args.rows, batch_rows=args.batch_rows,
+            d=args.d, bits=args.bits, seed=args.seed,
+        )
+        print(json.dumps(summary))
+        return 0
+    if args.smoke:
+        made_tmp = args.dir is None
+        workdir = args.dir or tempfile.mkdtemp(prefix="rp_recover_smoke_")
+        verdict = durable.crash_smoke(
+            workdir, rows=args.rows, batch_rows=args.batch_rows,
+            d=args.d, bits=args.bits, seed=args.seed,
+        )
+        if made_tmp and verdict["ok"]:
+            # clean pass: don't leak snapshot copies into TMPDIR; a
+            # failing run keeps the directory for forensics (named in
+            # the verdict via the per-case paths)
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+        print(json.dumps(verdict))
+        return 0 if verdict["ok"] else 1
+    if not args.dir:
+        raise SystemExit("recover requires DIR (or --smoke)")
+    status = durable.verify_snapshot(args.dir)
+    print(json.dumps(status))
+    return 0 if status["ok"] else 1
+
+
 def cmd_bench(args):
     from randomprojection_tpu.benchmark import emit_bench_output, run
 
@@ -734,6 +815,7 @@ def main(argv=None):
         "bench": cmd_bench,
         "stream-bench": cmd_stream_bench,
         "topk-bench": cmd_topk_bench,
+        "recover": cmd_recover,
         "doctor": cmd_doctor,
         "report": cmd_doctor,  # alias
         "lint": cmd_lint,

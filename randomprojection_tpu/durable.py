@@ -1,0 +1,900 @@
+"""Durable index lifecycle: snapshot/restore + exactly-once crash
+recovery (ISSUE 6; ROADMAP open item 5).
+
+Everything upstream of this module is build-once-then-query inside one
+process: ``serialize.py`` persists fitted *estimators*, while
+``SimHashIndex`` device chunks and the ingest cursor evaporate on a
+crash.  This module extends the streaming layer's recovery contract —
+ack-after-yield cursors, batches as pure functions of their row range —
+across **process restarts**, so "resume is bit-identical" survives a
+``kill -9``, not just a raised exception.
+
+Three layers:
+
+- **Snapshot format** — ``save_index``/``load_index`` spill an index's
+  packed-code chunks to per-chunk ``.npy`` files under a directory,
+  described by a versioned ``manifest.json`` carrying per-chunk SHA-256
+  payload checksums (and the tombstone bitmap, when any).  Torn states
+  are impossible by construction: every file is written
+  write-tmp → fsync → ``os.replace``, the manifest is committed LAST
+  (followed by a directory fsync), and chunk files are
+  generation-numbered so a rewrite never touches a file the
+  currently-committed manifest references.  Readers reject unknown
+  format versions loudly and verify every checksum before upload.
+- **Durable ingest** — ``DurableIngest`` binds ``stream_transform``'s
+  checkpoint cursor to the index snapshot it corresponds to: each
+  consumed batch appends one chunk file, and the cursor commit
+  (``rows_done``) and the chunk flush are ONE atomic manifest replace.
+  A crashed run resumed from disk replays exactly the uncommitted row
+  ranges, and the rebuilt index is bit-identical to an uninterrupted
+  run (chunk layout included).
+- **Fault harness** — deterministic kill points (``RP_DURABLE_KILL=
+  <point>@<n>`` self-delivers an uncatchable SIGKILL, exactly a
+  ``kill -9`` at that instant), a subprocess child entry
+  (``cli recover --child``) and ``crash_smoke``, which runs the full
+  kill matrix (mid-batch, post-yield pre-ack, mid-snapshot-rename) at
+  toy shapes, restarts each crashed run, and asserts no row range was
+  dropped or double-committed and the recovered index is bit-identical
+  to the clean run — wired into ``make verify`` before tier-1.
+
+Telemetry: ``index.snapshot.save``/``index.snapshot.load`` on every
+commit/restore, ``recover.resume`` (replayed ranges),
+``recover.orphan_chunk`` (uncommitted spills swept at resume) and
+``recover.checksum_mismatch`` (corruption, also in the doctor's
+degraded audit) — all registered in ``telemetry.EVENTS``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import sys
+from typing import Optional
+
+import numpy as np
+
+from randomprojection_tpu.streaming import (
+    StreamCursor,
+    _fsync_dir,
+    stream_transform,
+)
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+__all__ = [
+    "INDEX_FORMAT_VERSION",
+    "MANIFEST_NAME",
+    "KILL_POINTS",
+    "DurableIngest",
+    "save_index",
+    "load_index",
+    "read_manifest",
+    "verify_snapshot",
+    "check_coverage",
+    "demo_ingest",
+    "crash_smoke",
+]
+
+INDEX_FORMAT_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+# fault-injection points, in pipeline order; RP_DURABLE_KILL="<point>@<n>"
+# SIGKILLs the process the n-th time that point is reached
+KILL_POINTS = ("mid-batch", "post-yield-pre-ack", "mid-snapshot-rename")
+KILL_ENV = "RP_DURABLE_KILL"
+_kill_counts: dict = {}
+
+
+def _maybe_kill(point: str) -> None:
+    """Fault-injection hook: if ``RP_DURABLE_KILL=<point>@<n>`` names
+    this point, deliver an uncatchable SIGKILL on its n-th hit — no
+    cleanup, no atexit, no flushing: exactly a ``kill -9``."""
+    spec = os.environ.get(KILL_ENV)
+    if not spec:
+        return
+    want, _, nth = spec.partition("@")
+    if want != point:
+        return
+    _kill_counts[point] = _kill_counts.get(point, 0) + 1
+    if _kill_counts[point] >= int(nth or 1):
+        os.kill(os.getpid(), signal.SIGKILL)  # pragma: no cover — dies
+
+
+def _sha256(arr: np.ndarray) -> str:
+    """Payload checksum: over the raw row bytes, not the .npy container,
+    so verification is immune to header/layout differences."""
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def _write_npy_atomic(path: str, arr: np.ndarray) -> None:
+    """Crash-safe array spill: write-tmp → flush → fsync → ``os.replace``
+    — a reader never observes a torn file, and the payload is on disk
+    before the name exists."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _commit_manifest(dirpath: str, manifest: dict) -> None:
+    """THE commit point: the single atomic ``os.replace`` of
+    ``manifest.json`` flips the snapshot from old state to new state
+    with no intermediate visible.  Ordering for MACHINE crashes, not
+    just process crashes: spill payloads were fsync'd by
+    ``_write_npy_atomic``, but their rename directory entries need the
+    directory fsync BEFORE the manifest rename — otherwise a crash
+    could persist a manifest that references chunk files whose renames
+    never reached disk.  The directory fsync afterwards then makes the
+    manifest rename itself durable."""
+    _fsync_dir(dirpath)  # chunk renames reach disk before the commit
+    path = os.path.join(dirpath, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    _maybe_kill("mid-snapshot-rename")
+    os.replace(tmp, path)
+    _fsync_dir(dirpath)
+
+
+def _next_generation_from_files(dirpath: str) -> int:
+    """Smallest generation safely past every spill already on disk —
+    used when no READABLE manifest records one (fresh directory, or a
+    corrupt manifest being repaired by a re-save), so a new snapshot
+    never overwrites an existing file."""
+    import re
+
+    gen = -1
+    for fn in os.listdir(dirpath):
+        m = re.match(r"(?:chunk|tombstones)-(\d{6})", fn)
+        if m:
+            gen = max(gen, int(m.group(1)))
+    return gen + 1
+
+
+def _spill_chunk(dirpath: str, gen: int, seq: int, arr: np.ndarray,
+                 row0: int) -> dict:
+    """Write one chunk spill under its generation-numbered name and
+    return its manifest entry — the single source of the filename
+    template and entry schema (save, ingest commit and compaction all
+    spill through here, so the format cannot drift between writers)."""
+    fname = f"chunk-{gen:06d}-{seq:08d}.npy"
+    _write_npy_atomic(os.path.join(dirpath, fname), arr)
+    return {
+        "file": fname, "rows": int(arr.shape[0]), "row0": int(row0),
+        "sha256": _sha256(arr),
+    }
+
+
+def read_manifest(dirpath: str) -> dict:
+    """Load and validate a snapshot manifest; unknown format versions
+    (and non-index manifests) are rejected loudly, never guessed at."""
+    path = os.path.join(dirpath, MANIFEST_NAME)
+    with open(path) as f:
+        m = json.load(f)
+    version = m.get("format_version")
+    if version != INDEX_FORMAT_VERSION:
+        raise ValueError(
+            f"Unsupported index manifest version {version!r} in {path} "
+            f"(expected {INDEX_FORMAT_VERSION})"
+        )
+    if m.get("kind") != "simhash_index":
+        raise ValueError(
+            f"{path} is not a SimHash index manifest "
+            f"(kind={m.get('kind')!r})"
+        )
+    return m
+
+
+def check_coverage(manifest: dict) -> int:
+    """Assert the manifest's chunk row ranges tile ``[0, n_codes)``
+    exactly once, in order — the no-drop / no-double-commit invariant
+    the crash harness holds every recovered manifest to.  Returns the
+    covered row count; raises ``ValueError`` on any gap or overlap."""
+    pos = 0
+    for entry in manifest["chunks"]:
+        if entry["row0"] != pos:
+            raise ValueError(
+                f"chunk {entry['file']} starts at row {entry['row0']}, "
+                f"expected {pos}: row ranges must tile without gaps or "
+                "overlaps (a dropped or double-committed batch)"
+            )
+        pos += entry["rows"]
+    if pos != manifest["n_codes"]:
+        raise ValueError(
+            f"chunks cover {pos} rows but the manifest records "
+            f"n_codes={manifest['n_codes']}"
+        )
+    return pos
+
+
+def _estimator_fingerprint(est) -> dict:
+    """What makes two ingest estimators 'the same projection': the
+    class plus the full spec (seed included) when the estimator carries
+    one, else the resolved seed.  Recorded in the ingest manifest so a
+    resume with a same-SHAPE but different-PROJECTION estimator (e.g.
+    another seed) is refused instead of silently mixing two projections
+    in one index."""
+    fp = {"class": type(est).__name__}
+    spec = getattr(est, "spec_", None)
+    if spec is not None:
+        fp["spec"] = spec.to_dict()
+    elif hasattr(est, "seed_"):
+        fp["seed"] = int(est.seed_)
+    return fp
+
+
+def _referenced_files(manifest: dict) -> set:
+    refs = {e["file"] for e in manifest["chunks"]}
+    if manifest.get("tombstones"):
+        refs.add(manifest["tombstones"]["file"])
+    return refs
+
+
+def _scan_orphans(dirpath: str, manifest: Optional[dict]) -> list:
+    """Spill files present in the directory but not referenced by the
+    committed manifest: the debris of a crash between a chunk flush and
+    its manifest commit (plus any ``.tmp`` a kill mid-write left)."""
+    refs = _referenced_files(manifest) if manifest else set()
+    orphans = []
+    for fn in sorted(os.listdir(dirpath)):
+        if fn.endswith(".tmp") or (
+            fn.endswith(".npy")
+            and (fn.startswith("chunk-") or fn.startswith("tombstones-"))
+            and fn not in refs
+        ):
+            orphans.append(fn)
+    return orphans
+
+
+def _load_chunk_verified(dirpath: str, entry: dict) -> np.ndarray:
+    """Read one spill file and verify it against its manifest entry;
+    corruption fails loudly with a ``recover.checksum_mismatch`` event,
+    never a silently-wrong index."""
+    path = os.path.join(dirpath, entry["file"])
+    try:
+        arr = np.load(path)
+    except (OSError, ValueError) as e:
+        telemetry.emit(
+            EVENTS.RECOVER_CHECKSUM_MISMATCH, file=entry["file"],
+            error=repr(e),
+        )
+        raise ValueError(
+            f"snapshot chunk {path} is unreadable ({e}); the manifest "
+            "references it — the snapshot is corrupt"
+        ) from e
+    actual = _sha256(arr)
+    if actual != entry["sha256"]:
+        telemetry.emit(
+            EVENTS.RECOVER_CHECKSUM_MISMATCH, file=entry["file"],
+            expected=entry["sha256"], actual=actual,
+        )
+        raise ValueError(
+            f"snapshot chunk {path} fails checksum verification "
+            f"(expected sha256 {entry['sha256'][:16]}…, got "
+            f"{actual[:16]}…); refusing to load a corrupt index"
+        )
+    return arr
+
+
+def save_index(index, dirpath: str, *, ingest: Optional[dict] = None) -> dict:
+    """Write a durable snapshot of a ``SimHashIndex`` under ``dirpath``.
+
+    Per-chunk ``.npy`` spills (one per resident device chunk — chunk
+    structure round-trips) plus the tombstone bitmap, then one atomic
+    manifest commit.  Files are generation-numbered: a re-save over an
+    existing snapshot writes a NEW generation and only then unlinks the
+    old one's files, so a crash at any instant leaves either the old or
+    the new snapshot fully loadable — never a mix.  ``ingest`` attaches
+    the durable-ingest cursor binding (see ``DurableIngest``).  Returns
+    the committed manifest.
+    """
+    os.makedirs(dirpath, exist_ok=True)
+    old = None
+    try:
+        old = read_manifest(dirpath)
+    except FileNotFoundError:
+        pass
+    except ValueError:
+        # a corrupt/unknown-version manifest must not block the natural
+        # repair path (re-save the index) — nothing loadable exists to
+        # protect, but the generation must still advance past every
+        # on-disk spill name so no existing file is overwritten
+        pass
+    if old is not None:
+        gen = old.get("generation", 0) + 1
+    else:
+        gen = _next_generation_from_files(dirpath)
+    entries = []
+    for i, chunk in enumerate(index._chunks):
+        entries.append(_spill_chunk(
+            dirpath, gen, i, index._fetch_chunk_host(chunk), chunk.row0
+        ))
+    tomb = None
+    if index.n_deleted:
+        packed = np.packbits(index._dead, bitorder="little")
+        fname = f"tombstones-{gen:06d}.npy"
+        _write_npy_atomic(os.path.join(dirpath, fname), packed)
+        tomb = {
+            "file": fname, "deleted": int(index.n_deleted),
+            "sha256": _sha256(packed),
+        }
+    manifest = {
+        "format_version": INDEX_FORMAT_VERSION,
+        "kind": "simhash_index",
+        "n_bytes": int(index.n_bytes),
+        "n_bits": int(index.n_bits),
+        "n_codes": int(index.n_codes),
+        "generation": gen,
+        "chunks": entries,
+        "tombstones": tomb,
+    }
+    if ingest is not None:
+        manifest["ingest"] = ingest
+    _commit_manifest(dirpath, manifest)
+    # the new snapshot is committed: the previous generation's files are
+    # now unreferenced debris (a crash before this sweep just leaves
+    # orphans for the next resume's sweep)
+    for fn in _scan_orphans(dirpath, manifest):
+        os.unlink(os.path.join(dirpath, fn))
+    telemetry.emit(
+        EVENTS.INDEX_SNAPSHOT_SAVE, path=dirpath, generation=gen,
+        chunks=len(entries), n_codes=int(index.n_codes),
+        deleted=int(index.n_deleted),
+        **({"rows_done": ingest["rows_done"]} if ingest else {}),
+    )
+    return manifest
+
+
+def load_index(dirpath: str, *, mesh=None, data_axis: str = "data"):
+    """Rebuild a ``SimHashIndex`` from a snapshot directory.
+
+    The manifest's format version is checked first, every chunk payload
+    is SHA-256-verified before upload (corruption → loud ``ValueError``
+    + ``recover.checksum_mismatch``), chunk structure and global id
+    assignment are restored exactly, and the tombstone bitmap (if any)
+    is re-armed.  ``mesh`` re-shards the restored chunks — the snapshot
+    format is mesh-agnostic.
+    """
+    from randomprojection_tpu.models.sketch import SimHashIndex
+
+    manifest = read_manifest(dirpath)
+    check_coverage(manifest)
+    index = SimHashIndex(
+        np.empty((0, manifest["n_bytes"]), np.uint8),
+        n_bits=manifest["n_bits"], mesh=mesh, data_axis=data_axis,
+    )
+    for entry in manifest["chunks"]:
+        arr = _load_chunk_verified(dirpath, entry)
+        if arr.ndim != 2 or arr.shape != (entry["rows"], manifest["n_bytes"]):
+            raise ValueError(
+                f"snapshot chunk {entry['file']} has shape {arr.shape}, "
+                f"manifest says ({entry['rows']}, {manifest['n_bytes']})"
+            )
+        index.add(arr)
+    if index.n_codes != manifest["n_codes"]:
+        raise ValueError(
+            f"restored {index.n_codes} codes but the manifest records "
+            f"{manifest['n_codes']}"
+        )
+    tomb = manifest.get("tombstones")
+    if tomb:
+        packed = _load_chunk_verified(dirpath, tomb)
+        dead = np.unpackbits(
+            packed, count=manifest["n_codes"], bitorder="little"
+        ).astype(bool)
+        if int(dead.sum()) != tomb["deleted"]:
+            raise ValueError(
+                f"tombstone bitmap in {dirpath} marks {int(dead.sum())} "
+                f"codes deleted but the manifest records {tomb['deleted']}"
+            )
+        index._dead = dead
+        index._n_deleted = int(dead.sum())
+        index._dead_rev += 1
+    telemetry.emit(
+        EVENTS.INDEX_SNAPSHOT_LOAD, path=dirpath,
+        generation=manifest["generation"], chunks=len(manifest["chunks"]),
+        n_codes=int(index.n_codes), deleted=int(index.n_deleted),
+    )
+    return index
+
+
+def verify_snapshot(dirpath: str) -> dict:
+    """Operational status of a snapshot directory (the ``cli recover``
+    face): manifest validity, per-chunk checksum verification, orphan
+    spills, row-range coverage.  Reports instead of raising — a corrupt
+    chunk is a ``corrupt`` entry (and a ``recover.checksum_mismatch``
+    event), ``ok`` is the overall verdict."""
+    status: dict = {"path": dirpath, "ok": False}
+    try:
+        manifest = read_manifest(dirpath)
+    except FileNotFoundError:
+        status["error"] = f"no {MANIFEST_NAME} in {dirpath}"
+        return status
+    except (ValueError, OSError) as e:
+        # unknown version, garbled JSON, not-a-directory, permission
+        # denied … — all must come back as a status, not a traceback
+        status["error"] = str(e)
+        return status
+    try:
+        return _verify_manifest(dirpath, manifest, status)
+    except (KeyError, TypeError, AttributeError) as e:
+        # a structurally-malformed manifest (right version/kind, body
+        # truncated or hand-edited) must come back as a status, not a
+        # traceback — diagnosing exactly this is the command's job
+        status["error"] = (
+            f"malformed manifest body in {dirpath}: {e!r}"
+        )
+        return status
+
+
+def _verify_manifest(dirpath: str, manifest: dict, status: dict) -> dict:
+    status.update({
+        "format_version": manifest["format_version"],
+        "generation": manifest["generation"],
+        "n_codes": manifest["n_codes"],
+        "n_bytes": manifest["n_bytes"],
+        "n_bits": manifest["n_bits"],
+        "chunks": len(manifest["chunks"]),
+        "deleted": (manifest.get("tombstones") or {}).get("deleted", 0),
+        "rows_done": (manifest.get("ingest") or {}).get("rows_done"),
+    })
+    corrupt = []
+    entries = list(manifest["chunks"])
+    if manifest.get("tombstones"):
+        entries.append(manifest["tombstones"])
+    for entry in entries:
+        try:
+            _load_chunk_verified(dirpath, entry)
+        except ValueError as e:
+            corrupt.append({"file": entry["file"], "error": str(e)})
+    try:
+        check_coverage(manifest)
+        coverage_ok = True
+    except ValueError as e:
+        coverage_ok = False
+        corrupt.append({"file": MANIFEST_NAME, "error": str(e)})
+    status["corrupt"] = corrupt
+    status["coverage_ok"] = coverage_ok
+    status["orphan_chunks"] = _scan_orphans(dirpath, manifest)
+    status["ok"] = not corrupt
+    return status
+
+
+class DurableIngest:
+    """Crash-durable ingest of a packed-code stream into a
+    ``SimHashIndex``: the cursor commit and the chunk flush are one
+    atomic manifest update, so a ``kill -9`` anywhere leaves a state
+    that resumes exactly-once.
+
+    ``run(estimator, source)`` streams the source through the estimator
+    (any estimator whose streamed output is uint8 packed codes — i.e.
+    ``SignRandomProjection``), appends each committed batch to the
+    resident index AND to a chunk spill file, and commits
+    ``rows_done = lo + rows`` together with the new chunk entries in
+    one manifest replace.  Crash windows:
+
+    - **mid-batch** (before any durable write): the manifest still
+      names the previous batch boundary; resume replays this batch.
+    - **post-yield pre-ack** (chunk file written, manifest not): the
+      chunk file is an unreferenced orphan; resume sweeps it
+      (``recover.orphan_chunk``) and replays the batch, rewriting an
+      identical file (batches are pure functions of their row range).
+    - **mid-snapshot-rename** (manifest tmp written, not replaced):
+      the ``.tmp`` is swept with the orphans; the committed manifest is
+      still the previous state.
+
+    In every case the resumed run replays exactly the rows past the
+    committed ``rows_done`` and the final index — chunk layout included
+    — is bit-identical to an uninterrupted run, which the subprocess
+    kill harness (``crash_smoke``/``cli recover --smoke``) asserts at
+    every injection point.
+
+    ``commit_every_batches`` amortizes the per-commit fsyncs (a crash
+    then replays up to that many batches); ``compact_after_chunks``
+    folds the accumulated one-chunk-per-batch spills into a single
+    chunk (new snapshot generation) whenever the chunk count reaches
+    the threshold — bounding the per-query dispatch count a long
+    ingest would otherwise build up (the 1000-batch → 1000-dispatch
+    weak item).  Compaction preserves ids (ingest never tombstones), so
+    results are unchanged; chunk *layout* after a crash may then differ
+    from the clean run's, but the code content and every query result
+    remain bit-identical.
+    """
+
+    def __init__(self, path: str, *, commit_every_batches: int = 1,
+                 compact_after_chunks: Optional[int] = None):
+        if commit_every_batches < 1:
+            raise ValueError(
+                f"commit_every_batches must be >= 1, got "
+                f"{commit_every_batches}"
+            )
+        if compact_after_chunks is not None and compact_after_chunks < 2:
+            raise ValueError(
+                f"compact_after_chunks must be >= 2 or None, got "
+                f"{compact_after_chunks}"
+            )
+        self.path = path
+        self.commit_every_batches = int(commit_every_batches)
+        self.compact_after_chunks = compact_after_chunks
+
+    # -- state ---------------------------------------------------------------
+
+    def rows_done(self) -> int:
+        """The committed cursor: rows durably ingested (0 when the
+        directory has no manifest yet)."""
+        try:
+            manifest = read_manifest(self.path)
+        except FileNotFoundError:
+            return 0
+        ingest = manifest.get("ingest")
+        if ingest is None:
+            raise ValueError(
+                f"{self.path} holds a plain index snapshot, not a durable "
+                "ingest (no cursor binding in its manifest)"
+            )
+        return int(ingest["rows_done"])
+
+    def _resume_or_fresh(self, n_bytes: int, n_bits: int):
+        """Load the committed state (verifying checksums), sweep crash
+        debris, and report the resume point."""
+        try:
+            manifest = read_manifest(self.path)
+        except FileNotFoundError:
+            os.makedirs(self.path, exist_ok=True)
+            from randomprojection_tpu.models.sketch import SimHashIndex
+
+            index = SimHashIndex(
+                np.empty((0, n_bytes), np.uint8), n_bits=n_bits
+            )
+            return index, 0, [], 0
+        ingest = manifest.get("ingest")
+        if ingest is None:
+            raise ValueError(
+                f"{self.path} holds a plain index snapshot, not a durable "
+                "ingest run; point DurableIngest at its own directory"
+            )
+        if manifest["n_bytes"] != n_bytes or manifest["n_bits"] != n_bits:
+            raise ValueError(
+                f"durable ingest at {self.path} holds "
+                f"{manifest['n_bits']}-bit/{manifest['n_bytes']}-byte "
+                f"codes but the estimator streams {n_bits}-bit/"
+                f"{n_bytes}-byte codes; resuming would mix two projections"
+            )
+        recorded = ingest.get("estimator")
+        if recorded is not None and recorded != self._est_fp:
+            # same shape is NOT same projection: a different seed/spec
+            # would encode the replayed rows under a different matrix —
+            # permanently inconsistent neighbors with no error anywhere
+            raise ValueError(
+                f"durable ingest at {self.path} was written by estimator "
+                f"{recorded} but this run uses {self._est_fp}; resuming "
+                "would mix two projections in one index"
+            )
+        # sweep the debris of a crash BEFORE loading: uncommitted chunk
+        # spills and manifest tmps are replayed deterministically
+        for fn in _scan_orphans(self.path, manifest):
+            telemetry.emit(EVENTS.RECOVER_ORPHAN_CHUNK, path=self.path,
+                           file=fn)
+            os.unlink(os.path.join(self.path, fn))
+        check_coverage(manifest)
+        index = load_index(self.path)
+        return (
+            index, int(ingest["rows_done"]), list(manifest["chunks"]),
+            int(manifest["generation"]),
+        )
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self, estimator, source):
+        """Ingest ``source`` through ``estimator`` into the durable
+        index, resuming from the committed cursor; returns the live
+        ``SimHashIndex`` (fully committed through the last batch)."""
+        estimator._check_is_fitted()
+        out_dtype = estimator._stream_out_dtype()
+        if out_dtype is None or np.dtype(out_dtype) != np.uint8:
+            raise ValueError(
+                "DurableIngest ingests packed uint8 codes (e.g. "
+                "SignRandomProjection); this estimator streams "
+                f"{out_dtype!r}"
+            )
+        n_bytes = int(estimator._stream_out_width())
+        n_bits = int(estimator.n_components_)
+        self._est_fp = _estimator_fingerprint(estimator)
+        index, rows_done, entries, gen = self._resume_or_fresh(
+            n_bytes, n_bits
+        )
+        if rows_done > source.n_rows:
+            raise ValueError(
+                f"committed cursor rows_done={rows_done} exceeds the "
+                f"source's {source.n_rows} rows; wrong source for this "
+                "ingest directory"
+            )
+        if rows_done:
+            telemetry.emit(
+                EVENTS.RECOVER_RESUME, path=self.path,
+                rows_done=rows_done,
+                replay_rows=int(source.n_rows - rows_done),
+            )
+        self._entries = entries
+        self._generation = gen
+        pending: list = []
+        for lo, y in stream_transform(
+            estimator, source, cursor=StreamCursor(rows_done)
+        ):
+            _maybe_kill("mid-batch")
+            codes = np.ascontiguousarray(y, dtype=np.uint8)
+            index.add(codes)
+            pending.append((lo, codes))
+            if len(pending) >= self.commit_every_batches:
+                self._commit(index, pending)
+                pending = []
+                if (
+                    self.compact_after_chunks is not None
+                    and len(self._entries) >= self.compact_after_chunks
+                ):
+                    self._compact_commit(index)
+        if pending:
+            self._commit(index, pending)
+        return index
+
+    def _commit(self, index, pending: list) -> None:
+        """One durable commit: flush the pending batches' chunk files,
+        then bind the advanced cursor to them in a single atomic
+        manifest replace (THE ack — a crash on either side of it is a
+        clean replay, never a drop or a double-commit)."""
+        rows_done = None
+        for lo, codes in pending:
+            self._entries.append(_spill_chunk(
+                self.path, self._generation, len(self._entries), codes, lo
+            ))
+            rows_done = int(lo + codes.shape[0])
+        _maybe_kill("post-yield-pre-ack")
+        self._write_manifest(index, rows_done)
+        telemetry.emit(
+            EVENTS.INDEX_SNAPSHOT_SAVE, path=self.path,
+            generation=self._generation, chunks=len(self._entries),
+            n_codes=int(index.n_codes), deleted=int(index.n_deleted),
+            rows_done=rows_done,
+        )
+
+    def _write_manifest(self, index, rows_done: int) -> None:
+        _commit_manifest(self.path, {
+            "format_version": INDEX_FORMAT_VERSION,
+            "kind": "simhash_index",
+            "n_bytes": int(index.n_bytes),
+            "n_bits": int(index.n_bits),
+            "n_codes": int(index.n_codes),
+            "generation": self._generation,
+            "chunks": self._entries,
+            "tombstones": None,
+            "ingest": {
+                "rows_done": int(rows_done),
+                "estimator": self._est_fp,
+            },
+        })
+
+    def _compact_commit(self, index) -> None:
+        """Fold the accumulated per-batch chunks into one (new snapshot
+        generation), then sweep the superseded files: old-state files
+        are unlinked only AFTER the new manifest is committed, so a
+        crash at any instant leaves a loadable snapshot.  The compacted
+        host array is read back from the COMMITTED spill files — every
+        ingested code is already on disk — so compaction pays disk
+        reads plus one re-upload, never a full-index device fetch."""
+        rows_done = self.rows_done()
+        codes = _codes_of(self.path)
+        # ingest never tombstones, so the committed codes in id order
+        # ARE the compacted content; rebuild the resident index from
+        # them (the device side of compact()) and spill the same host
+        # array as the new generation's single chunk
+        index._rebuild_from_host(codes)
+        self._generation += 1
+        old_files = [e["file"] for e in self._entries]
+        self._entries = []
+        if codes.shape[0]:
+            self._entries.append(_spill_chunk(
+                self.path, self._generation, 0, codes, 0
+            ))
+        self._write_manifest(index, rows_done)
+        for fn in old_files:
+            try:
+                os.unlink(os.path.join(self.path, fn))
+            except FileNotFoundError:  # pragma: no cover — already swept
+                pass
+
+
+# -- deterministic demo ingest + subprocess crash harness --------------------
+
+
+def demo_ingest(path: str, *, rows: int = 192, batch_rows: int = 32,
+                d: int = 16, bits: int = 64, seed: int = 0,
+                commit_every: int = 1,
+                compact_after: Optional[int] = None) -> dict:
+    """The harness child: a fully deterministic SimHash ingest (seeded
+    synthetic rows → ``SignRandomProjection`` on the numpy backend →
+    ``DurableIngest``) whose every byte is a pure function of the
+    arguments — so a killed-and-resumed run can be compared
+    bit-for-bit against a clean one.  Returns a summary dict."""
+    from randomprojection_tpu.models.sketch import SignRandomProjection
+    from randomprojection_tpu.streaming import CallableSource
+
+    def read(lo, hi):
+        rng = np.random.default_rng([seed, lo])
+        return rng.standard_normal((hi - lo, d), dtype=np.float32)
+
+    source = CallableSource(read, rows, d, dtype=np.float32,
+                            batch_rows=batch_rows)
+    est = SignRandomProjection(bits, random_state=seed, backend="numpy")
+    est.fit_source(source)
+    ingest = DurableIngest(path, commit_every_batches=commit_every,
+                           compact_after_chunks=compact_after)
+    index = ingest.run(est, source)
+    return {
+        "path": path,
+        "rows_done": ingest.rows_done(),
+        "n_codes": int(index.n_codes),
+        "chunks": len(index._chunks),
+    }
+
+
+def _child_argv(path: str, *, rows: int, batch_rows: int, d: int,
+                bits: int, seed: int) -> list:
+    return [
+        sys.executable, "-m", "randomprojection_tpu", "recover",
+        "--child", path, "--rows", str(rows),
+        "--batch-rows", str(batch_rows), "--d", str(d),
+        "--bits", str(bits), "--seed", str(seed),
+    ]
+
+
+def run_child(path: str, *, rows: int = 192, batch_rows: int = 32,
+              d: int = 16, bits: int = 64, seed: int = 0,
+              kill: Optional[str] = None, timeout: float = 180.0):
+    """Run one harness child ingest as a real subprocess (so SIGKILL
+    kills a whole process, cache and buffers included).  ``kill`` is a
+    ``"<point>@<n>"`` spec for ``RP_DURABLE_KILL``; returns the
+    ``CompletedProcess`` (returncode ``-SIGKILL`` when the kill
+    fired)."""
+    import subprocess
+
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop(KILL_ENV, None)
+    if kill is not None:
+        env[KILL_ENV] = kill
+    return subprocess.run(
+        _child_argv(path, rows=rows, batch_rows=batch_rows, d=d,
+                    bits=bits, seed=seed),
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
+def _codes_of(dirpath: str) -> np.ndarray:
+    """All committed codes of a snapshot, in global id order, straight
+    from the verified spill files (no device round-trip)."""
+    manifest = read_manifest(dirpath)
+    check_coverage(manifest)
+    parts = [
+        _load_chunk_verified(dirpath, e) for e in manifest["chunks"]
+    ]
+    return (
+        np.concatenate(parts, axis=0)
+        if parts
+        else np.empty((0, manifest["n_bytes"]), np.uint8)
+    )
+
+
+def crash_smoke(workdir: str, *, rows: int = 192, batch_rows: int = 32,
+                d: int = 16, bits: int = 64, seed: int = 0,
+                query_m: int = 5) -> dict:
+    """The process-kill fault matrix at toy shapes: one clean run, then
+    for each ``KILL_POINTS`` entry a run SIGKILLed at that point (third
+    hit — mid-stream, not at an edge) and restarted.  Asserts for every
+    recovered directory: the kill actually fired, row ranges tile
+    exactly once, codes are bit-identical to the clean run, the
+    manifests' chunk checksums agree, and ``query_topk`` answers match.
+    Returns a verdict dict (``ok`` plus per-case detail); raises
+    nothing — the caller turns ``ok`` into an exit code."""
+    import shutil
+
+    shapes = dict(rows=rows, batch_rows=batch_rows, d=d, bits=bits,
+                  seed=seed)
+
+    def fresh(name: str) -> str:
+        # a leftover completed ingest from a previous smoke would resume
+        # instantly (zero replay), so the kill point would never fire
+        # and a healthy system would read as a harness failure — every
+        # case starts from an empty directory
+        path = os.path.join(workdir, name)
+        shutil.rmtree(path, ignore_errors=True)
+        return path
+
+    import subprocess
+
+    def child(path, **kw):
+        # 'raises nothing' includes a wedged child: a timeout becomes a
+        # failed case in the verdict, not a traceback through make verify
+        try:
+            return run_child(path, **kw)
+        except subprocess.TimeoutExpired as e:
+            return subprocess.CompletedProcess(
+                e.cmd, returncode=999,
+                stdout="", stderr=f"harness child timed out: {e}",
+            )
+
+    clean_dir = fresh("clean")
+    proc = child(clean_dir, **shapes)
+    if proc.returncode != 0:
+        return {
+            "ok": False, "error": "clean ingest failed",
+            "returncode": proc.returncode,
+            "stderr": proc.stderr[-2000:],
+        }
+    clean_manifest = read_manifest(clean_dir)
+    clean_codes = _codes_of(clean_dir)
+    rng = np.random.default_rng(seed + 1)
+    queries = rng.integers(
+        0, 256, size=(8, clean_manifest["n_bytes"]), dtype=np.uint8
+    )
+    clean_index = load_index(clean_dir)
+    ref_d, ref_i = clean_index.query_topk(queries, query_m)
+    cases = []
+    ok = True
+    for point in KILL_POINTS:
+        case: dict = {"kill_at": point}
+        case_dir = fresh(point.replace("-", "_"))
+        crashed = child(case_dir, kill=f"{point}@3", **shapes)
+        case["crash_returncode"] = crashed.returncode
+        if crashed.returncode != -signal.SIGKILL:
+            case["error"] = (
+                "kill point never fired (run finished with "
+                f"rc={crashed.returncode}): the harness is not covering "
+                "this window"
+            )
+            ok = False
+            cases.append(case)
+            continue
+        resumed = child(case_dir, **shapes)
+        case["resume_returncode"] = resumed.returncode
+        if resumed.returncode != 0:
+            case["error"] = f"resume failed: {resumed.stderr[-2000:]}"
+            ok = False
+            cases.append(case)
+            continue
+        try:
+            manifest = read_manifest(case_dir)
+            check_coverage(manifest)
+            codes = _codes_of(case_dir)
+        except ValueError as e:
+            case["error"] = f"recovered state invalid: {e}"
+            ok = False
+            cases.append(case)
+            continue
+        case["rows_done"] = manifest["ingest"]["rows_done"]
+        case["bit_identical_codes"] = bool(
+            np.array_equal(codes, clean_codes)
+        )
+        case["manifest_chunks_identical"] = [
+            e["sha256"] for e in manifest["chunks"]
+        ] == [e["sha256"] for e in clean_manifest["chunks"]]
+        index = load_index(case_dir)
+        got_d, got_i = index.query_topk(queries, query_m)
+        case["query_results_match"] = bool(
+            np.array_equal(got_d, ref_d) and np.array_equal(got_i, ref_i)
+        )
+        if not (
+            case["bit_identical_codes"]
+            and case["manifest_chunks_identical"]
+            and case["query_results_match"]
+            and case["rows_done"] == rows
+        ):
+            ok = False
+        cases.append(case)
+    return {"ok": ok, "workdir": workdir, "shapes": shapes,
+            "cases": cases}

@@ -245,13 +245,19 @@ def _start_host_copy(handle) -> None:
 class _IndexChunk:
     """One device-resident block of packed codes: ``b`` is ``(rows_pad,
     n_bytes)`` uint8 (row-sharded over the mesh when the index has one),
-    ``n`` the real row count (pad rows are trailing zeros)."""
+    ``n`` the real row count (pad rows are trailing zeros), ``row0`` the
+    global id of the chunk's first row.  ``dead_dev``/``dead_rev`` cache
+    the chunk's device-resident tombstone mask (None = no deleted rows
+    in this chunk) against the index's tombstone revision."""
 
-    __slots__ = ("b", "n")
+    __slots__ = ("b", "n", "row0", "dead_dev", "dead_rev")
 
-    def __init__(self, b, n: int):
+    def __init__(self, b, n: int, row0: int = 0):
         self.b = b
         self.n = n
+        self.row0 = row0
+        self.dead_dev = None
+        self.dead_rev = -1
 
 
 class SimHashIndex:
@@ -290,6 +296,12 @@ class SimHashIndex:
     int32 end to end, so ``add`` refuses past that rather than silently
     wrapping global ids (scale out further by sharding more chips over a
     mesh, which divides rows without widening the id space).
+
+    Thread-safety: queries may run concurrently with each other, but
+    MUTATION (``add``/``delete``/``compact``) requires the index to be
+    quiescent — no query in flight on another thread.  Serving stacks
+    coordinate externally (e.g. drain a ``TopKServer`` before
+    compacting).
     """
 
     def __init__(self, codes, *, mesh=None, data_axis: str = "data",
@@ -311,6 +323,12 @@ class SimHashIndex:
         self._chunks: list = []
         self.n_codes = 0
         self._topk_fns: dict = {}
+        # tombstone bitmap (ISSUE 6): None until the first delete(); a
+        # host bool array over global ids afterwards.  _dead_rev
+        # invalidates the per-chunk device mask caches on mutation.
+        self._dead: Optional[np.ndarray] = None
+        self._n_deleted = 0
+        self._dead_rev = 0
         if codes.shape[0]:
             self._upload_chunk(codes)
 
@@ -345,7 +363,11 @@ class SimHashIndex:
             b = jax.device_put(
                 codes, NamedSharding(self.mesh, P(self.data_axis, None))
             )
-        self._chunks.append(_IndexChunk(b, n))
+        self._chunks.append(_IndexChunk(b, n, self.n_codes))
+        if self._dead is not None:
+            self._dead = np.concatenate(
+                [self._dead, np.zeros(n, dtype=bool)]
+            )
         self.n_codes += n
 
     def add(self, codes):
@@ -358,6 +380,179 @@ class SimHashIndex:
         if codes.shape[0]:
             self._upload_chunk(codes)
         return self
+
+    # -- online mutation: tombstones + compaction (ISSUE 6) ------------------
+
+    @property
+    def n_deleted(self) -> int:
+        """Codes tombstoned by ``delete`` and not yet folded by
+        ``compact``."""
+        return self._n_deleted
+
+    @property
+    def n_live(self) -> int:
+        """Codes that can still win a query: ``n_codes - n_deleted``."""
+        return self.n_codes - self._n_deleted
+
+    def delete(self, ids) -> int:
+        """Tombstone codes by global id; returns how many were newly
+        deleted (already-deleted ids are idempotent).
+
+        Deleted codes keep their global ids (no renumbering) but are
+        filtered inside ``query_topk``'s selection — on the device path
+        their distances are masked to the sentinel before the scanned
+        top-k, on the dense-fallback path their columns are masked
+        before host selection — so a deleted code can never appear in a
+        result.  The plain ``query``/``query_cosine`` distance matrices
+        still cover every id (analysis surface; the column layout IS
+        the id space).  ``compact()`` folds tombstones and reclaims the
+        device memory; ``save()`` persists the bitmap in the snapshot
+        manifest.
+        """
+        ids = np.atleast_1d(np.asarray(ids))
+        if ids.size == 0:
+            return 0
+        if not np.issubdtype(ids.dtype, np.integer):
+            raise ValueError(
+                f"delete ids must be integers, got dtype {ids.dtype}"
+            )
+        # dedupe before counting: duplicate ids in one call would each
+        # count as "newly deleted" while the bitmap flips once, skewing
+        # n_deleted/n_live and making the saved manifest's deleted count
+        # disagree with its own bitmap (an unloadable snapshot)
+        ids = np.unique(ids)
+        lo, hi = int(ids.min()), int(ids.max())
+        if lo < 0 or hi >= self.n_codes:
+            raise ValueError(
+                f"delete ids must be in [0, {self.n_codes}), got "
+                f"[{lo}, {hi}]"
+            )
+        if self._dead is None:
+            self._dead = np.zeros(self.n_codes, dtype=bool)
+        newly = int(np.count_nonzero(~self._dead[ids]))
+        if newly:
+            self._dead[ids] = True
+            self._n_deleted += newly
+            self._dead_rev += 1  # invalidate per-chunk device masks
+        return newly
+
+    def _chunk_dead_device(self, chunk):
+        """The chunk's device-resident tombstone mask ``(rows_pad,)``
+        uint8 (1 = deleted), or None when the chunk has no deleted rows
+        — the unmasked (pre-tombstone) kernel then serves it at zero
+        overhead.  Cached per chunk against ``_dead_rev``."""
+        if self._dead is None:
+            return None
+        if chunk.dead_rev == self._dead_rev:
+            return chunk.dead_dev
+        sl = self._dead[chunk.row0 : chunk.row0 + chunk.n]
+        if not sl.any():
+            dev = None
+        else:
+            mask = np.zeros(chunk.b.shape[0], dtype=np.uint8)
+            mask[: chunk.n] = sl
+            if self.mesh is None:
+                import jax.numpy as jnp
+
+                dev = jnp.asarray(mask)
+            else:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                dev = jax.device_put(
+                    mask, NamedSharding(self.mesh, P(self.data_axis))
+                )
+        chunk.dead_dev = dev
+        chunk.dead_rev = self._dead_rev
+        return dev
+
+    def _fetch_chunk_host(self, chunk) -> np.ndarray:
+        """Host copy of one chunk's REAL rows — a deliberate full-chunk
+        d2h used only by the cold snapshot/compact paths, never per
+        query (the serving paths overlap their fetches instead)."""
+        _start_host_copy(chunk.b)
+        return np.asarray(chunk.b)[: chunk.n]
+
+    def compact(self) -> np.ndarray:
+        """Fold tombstones and merge every chunk into ONE resident
+        chunk; returns the old global ids of the surviving codes in
+        their new id order (``new id i`` was ``mapping[i]``; the
+        identity when nothing was deleted).
+
+        Two costs this pays down at once: deleted codes stop occupying
+        HBM and scan steps, and a finely-chunked index (e.g. one chunk
+        per streamed ingest batch — the 1000-batch stream that built a
+        1000-dispatch query) collapses to a single dispatch per query
+        tile.  Host-side rebuild: O(n_codes · n_bytes) host memory and
+        one full re-upload — a maintenance operation, not a serving-path
+        one.  Global ids are reassigned compactly; callers holding old
+        ids translate through the returned mapping.
+
+        NOT safe under concurrent queries: like ``add``/``delete``, the
+        index must be quiescent while mutating — a ``query_topk`` racing
+        the rebuild could observe the empty intermediate state or return
+        ids under the pre-compaction numbering.  With a ``TopKServer``
+        on this index, ``close()`` it (drain) before compacting and
+        start a fresh server after.
+        """
+        parts = [self._fetch_chunk_host(c) for c in self._chunks]
+        codes = (
+            np.concatenate(parts, axis=0)
+            if parts
+            else np.empty((0, self.n_bytes), np.uint8)
+        )
+        if self._dead is not None:
+            mapping = np.flatnonzero(~self._dead).astype(np.int64)
+            codes = codes[~self._dead]
+        else:
+            mapping = np.arange(self.n_codes, dtype=np.int64)
+        self._rebuild_from_host(codes)
+        return mapping
+
+    def _rebuild_from_host(self, codes: np.ndarray) -> None:
+        """Replace every resident chunk with ONE chunk holding
+        ``codes`` and clear the tombstone state — the device-side half
+        of ``compact()``, also called by maintenance paths that already
+        hold the compacted host array (the durable-ingest compactor
+        reads it back from its committed spill files, skipping the
+        device fetch ``compact()`` would pay).  The caller guarantees
+        ``codes`` is the live code set in id order."""
+        old_n, old_chunks = self.n_codes, len(self._chunks)
+        self._chunks = []
+        self.n_codes = 0
+        self._dead = None
+        self._n_deleted = 0
+        self._dead_rev += 1
+        if codes.shape[0]:
+            self._upload_chunk(np.ascontiguousarray(codes))
+        telemetry.registry().counter_inc("simhash.compactions")
+        telemetry.emit(
+            EVENTS.INDEX_COMPACT, chunks_before=old_chunks,
+            chunks_after=len(self._chunks), n_codes=self.n_codes,
+            dropped=int(old_n - self.n_codes),
+        )
+
+    # -- durable snapshot/restore (ISSUE 6; see durable.py) ------------------
+
+    def save(self, path: str) -> dict:
+        """Durable snapshot of the index into directory ``path``:
+        per-chunk ``.npy`` spills plus a versioned, checksummed
+        ``manifest.json`` committed write-tmp → fsync → ``os.replace``
+        (torn states impossible; see ``durable.save_index``).  Returns
+        the manifest."""
+        from randomprojection_tpu import durable
+
+        return durable.save_index(self, path)
+
+    @classmethod
+    def load(cls, path: str, *, mesh=None, data_axis: str = "data"):
+        """Restore an index saved by ``save`` (any process, any mesh
+        shape): manifest version and per-chunk checksums are verified
+        loudly before upload; chunk structure and the tombstone bitmap
+        round-trip exactly (see ``durable.load_index``)."""
+        from randomprojection_tpu import durable
+
+        return durable.load_index(path, mesh=mesh, data_axis=data_axis)
 
     def _query_fn(self):
         import jax
@@ -461,7 +656,8 @@ class SimHashIndex:
         """Top-``m`` nearest codes per query, selected ON DEVICE.
 
         Returns ``(dist, idx)``, each ``(n_queries, m_eff)`` int32 with
-        ``m_eff = min(m, n_codes)``, sorted by ascending Hamming distance.
+        ``m_eff = min(m, n_live)`` (tombstoned codes neither count nor
+        appear — see ``delete``), sorted by ascending Hamming distance.
         Exact ties are broken by the LOWER global code id — a total order,
         so the result is deterministic and identical across mesh shapes,
         chunk layouts, and tiling (each shard's ``lax.top_k`` is stable,
@@ -491,9 +687,18 @@ class SimHashIndex:
         A = self._check_queries(A)
         if self.n_codes == 0:
             raise ValueError("query_topk on an empty index")
+        if self.n_live == 0:
+            raise ValueError(
+                "query_topk on an index whose codes are all deleted "
+                "(tombstoned); compact() or add() live codes first"
+            )
         import jax.numpy as jnp
 
-        m_eff = int(min(m, self.n_codes))
+        # m_eff counts LIVE codes only: tombstoned rows are masked to the
+        # sentinel distance before selection (device path) or before the
+        # host select (dense fallback), so they can never win — and the
+        # result width never includes sentinel filler
+        m_eff = int(min(m, self.n_live))
         if not all(
             _topk_key_fits_int32(
                 self.n_bytes * 8,
@@ -511,9 +716,15 @@ class SimHashIndex:
             )
             out_d = np.empty((A.shape[0], m_eff), dtype=np.int32)
             out_i = np.empty((A.shape[0], m_eff), dtype=np.int32)
+            dense_sentinel = np.int32(self.n_bytes * 8 + 1)
             for lo in range(0, A.shape[0], tile):
                 hi = min(lo + tile, A.shape[0])
-                d, i = _host_topk_select(self.query(A[lo:hi], tile=tile), m_eff)
+                D = self.query(A[lo:hi], tile=tile)
+                if self._dead is not None:
+                    # tombstoned columns lose every comparison: the same
+                    # filtered-selection contract as the device path
+                    D[:, self._dead] = dense_sentinel
+                d, i = _host_topk_select(D, m_eff)
                 out_d[lo:hi], out_i[lo:hi] = d, i
             return out_d, out_i
         nq = A.shape[0]
@@ -585,20 +796,26 @@ class SimHashIndex:
     def _chunk_topk(self, a, chunk, m_c: int):
         """Device top-``m_c`` of one chunk for one query tile.  Returns
         ``(dist, local_idx)`` of shape ``(t, m_c)`` (mesh: ``(t, p·m_c)``
-        — per-shard candidates, ids already chunk-global).  Pad rows are
-        masked to an impossible distance before selection."""
-        fn = self._get_topk_fn(
-            a.shape, chunk.b.shape[0], m_c
-        )
+        — per-shard candidates, ids already chunk-global).  Pad rows —
+        and, when the chunk carries tombstones, deleted rows — are
+        masked to an impossible distance before selection; a chunk with
+        no deletions runs the exact pre-tombstone kernel."""
         import jax.numpy as jnp
 
+        dead = self._chunk_dead_device(chunk)
+        fn = self._get_topk_fn(
+            a.shape, chunk.b.shape[0], m_c, masked=dead is not None
+        )
+        if dead is not None:
+            return fn(a, chunk.b, jnp.int32(chunk.n), dead)
         return fn(a, chunk.b, jnp.int32(chunk.n))
 
-    def _get_topk_fn(self, a_shape, rows_pad: int, m_c: int):
+    def _get_topk_fn(self, a_shape, rows_pad: int, m_c: int, *,
+                     masked: bool = False):
         import jax
         import jax.numpy as jnp
 
-        key = (tuple(a_shape), rows_pad, m_c)
+        key = (tuple(a_shape), rows_pad, m_c, masked)
         fn = self._topk_fns.get(key)
         if fn is not None:
             return fn
@@ -646,8 +863,10 @@ class SimHashIndex:
                 f"block={blk}"
             )
 
-        def local_topk(a, b, n_real):
-            # a (t, nbytes) uint8, b (rows_local, nbytes) uint8 per shard
+        def local_topk(a, b, n_real, dead=None):
+            # a (t, nbytes) uint8, b (rows_local, nbytes) uint8 per shard;
+            # dead (rows_local,) uint8 tombstone mask in the masked
+            # variant (1 = deleted, filtered like a pad row)
             if self.mesh is None:
                 row0 = jnp.int32(0)
             else:
@@ -657,14 +876,23 @@ class SimHashIndex:
             pad = nblk * blk - rows_local
             if pad:
                 b = jnp.pad(b, ((0, pad), (0, 0)))
+                if dead is not None:
+                    dead = jnp.pad(dead, (0, pad))
             b_blocks = b.reshape(nblk, blk, b.shape[1])
+            dead_blocks = (
+                None if dead is None else dead.reshape(nblk, blk)
+            )
             t = a.shape[0]
             w = jnp.int32(width)
             pos_blk = jnp.arange(blk, dtype=jnp.int32) + m_c
 
             def step(carry, inp):
                 best_key, best_i = carry
-                b_blk, blk_i = inp
+                if dead_blocks is None:
+                    b_blk, blk_i = inp
+                    dead_blk = None
+                else:
+                    b_blk, blk_i, dead_blk = inp
                 s_b = unpack_pm1(b_blk)
                 dot = jax.lax.dot_general(
                     a_s, s_b,
@@ -677,12 +905,15 @@ class SimHashIndex:
                 # shard's real range), upload padding is global-trailing
                 local_ids = blk_i * blk + jnp.arange(blk, dtype=jnp.int32)
                 ids = row0 + local_ids
-                d = jnp.where(
-                    (local_ids[None, :] < rows_local)
-                    & (ids[None, :] < n_real),
-                    d,
-                    jnp.int32(sentinel),
+                keep = (local_ids[None, :] < rows_local) & (
+                    ids[None, :] < n_real
                 )
+                if dead_blk is not None:
+                    # tombstoned rows are filtered in the SELECTION, not
+                    # post-hoc: a deleted code can never displace a live
+                    # one from the running top-m (ISSUE 6)
+                    keep = keep & (dead_blk[None, :] == 0)
+                d = jnp.where(keep, d, jnp.int32(sentinel))
                 # keys over [carry | block]: the carry keys re-base to
                 # position [0, m_c) (they are already (dist, id)-sorted,
                 # and their ids are lower than this block's), the block
@@ -719,9 +950,11 @@ class SimHashIndex:
                 # the scanned b varies over the mesh axis, so the carry
                 # must be marked varying too (shard_map vma tracking)
                 init = jax.lax.pcast(init, (data_axis,), to="varying")
+            xs = (b_blocks, jnp.arange(nblk, dtype=jnp.int32))
+            if dead_blocks is not None:
+                xs = xs + (dead_blocks,)
             (best_key, best_i), _ = jax.lax.scan(
-                step, init,
-                (b_blocks, jnp.arange(nblk, dtype=jnp.int32)),
+                step, init, xs,
                 unroll=min(nblk, self._TOPK_UNROLL),
             )
             return best_key // w, best_i
@@ -731,10 +964,13 @@ class SimHashIndex:
         else:
             from jax.sharding import PartitionSpec as P
 
+            in_specs = (P(), P(data_axis, None), P())
+            if masked:
+                in_specs = in_specs + (P(data_axis),)
             fn = jax.jit(
                 jax.shard_map(
                     local_topk, mesh=self.mesh,
-                    in_specs=(P(), P(data_axis, None), P()),
+                    in_specs=in_specs,
                     out_specs=(P(None, data_axis), P(None, data_axis)),
                 )
             )
@@ -773,6 +1009,11 @@ class TopKServer:
     emits a ``serve.topk.error`` event + ``serve.topk.errors`` counter —
     a failing device must not be invisible to telemetry); the server
     itself keeps serving subsequent batches.
+
+    The served index must not be MUTATED (``add``/``delete``/
+    ``compact``) while the server is live — the dispatcher queries it
+    from its own thread; ``close()`` (drain) first, mutate, then start
+    a fresh server.
 
     Backpressure: the submit queue is BOUNDED (``max_pending``
     requests).  A dispatcher that stalls — a hung device, a wedged
@@ -830,6 +1071,14 @@ class TopKServer:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "TopKServer":
+        if self._closed.is_set():
+            # a closed server must stay closed: starting a dispatcher
+            # over a queue whose sentinel already drained would strand
+            # every future submitted through the race window
+            raise RuntimeError(
+                "server closed: cannot start() a closed TopKServer — "
+                "construct a new one"
+            )
         if self._thread is not None:
             raise RuntimeError("TopKServer already started")
         self._thread = threading.Thread(
@@ -872,7 +1121,12 @@ class TopKServer:
         fut: Future = Future()
         with self._submit_lock:
             if self._closed.is_set():
-                raise RuntimeError("TopKServer is closed")
+                # fail fast and say so: the dispatcher is (or will be)
+                # gone, so enqueueing would strand the future forever
+                raise RuntimeError(
+                    "server closed: TopKServer.submit() after close() — "
+                    "the dispatcher no longer drains the queue"
+                )
             # submits are serialized by the lock and the dispatcher only
             # drains, so this check is the bound: the queue can never
             # exceed max_pending requests, and close()'s sentinel always

@@ -98,9 +98,15 @@ def load_model(path: str, *, backend: Optional[str] = None):
     """Load a fitted estimator saved by ``save_model``.
 
     ``backend`` overrides the execution backend ('numpy'/'jax'); the
-    projection re-materializes from the stored seed.  If a matrix bundle
-    exists it is NOT loaded implicitly — the seed is the source of truth
-    (pass the bundle to analyses that need the exact f64 matrix).
+    projection re-materializes from the stored seed.  A matrix bundle is
+    never loaded implicitly — the seed is the source of truth (pass the
+    bundle to analyses that need the exact f64 matrix) — but a payload
+    saved with ``include_matrix=True`` names its sibling ``.npz`` as
+    part of the artifact, and loading verifies the bundle EXISTS: a
+    missing one means the artifact was copied partially, and the exact-
+    matrix analysis that eventually reaches for it would fail far from
+    the cause.  Re-save without ``include_matrix`` for a matrix-less
+    single-file artifact.
     """
     with open(path) as f:
         payload = json.load(f)
@@ -113,6 +119,22 @@ def load_model(path: str, *, backend: Optional[str] = None):
     cls = _registry().get(payload.get("class"))
     if cls is None:
         raise ValueError(f"Unknown model class {payload.get('class')!r} in {path}")
+    matrix_file = payload.get("matrix_file")
+    if matrix_file is not None:
+        # the payload promises a sibling matrix bundle: a missing one
+        # means the artifact was copied partially (or the .npz deleted),
+        # and any later exact-matrix analysis would fail far from the
+        # cause with an opaque error — name the expected path HERE
+        bundle = os.path.join(
+            os.path.dirname(os.path.abspath(path)), matrix_file
+        )
+        if not os.path.exists(bundle):
+            raise ValueError(
+                f"{path} was saved with include_matrix=True but its "
+                f"matrix bundle is missing: expected {bundle} alongside "
+                "it.  Restore the sibling .npz, or re-save the model "
+                "without include_matrix."
+            )
 
     if "countsketch" in payload:
         d = payload["countsketch"]

@@ -82,6 +82,24 @@ __all__ = [
 ]
 
 
+def _fsync_dir(dirpath: str) -> None:
+    """fsync a DIRECTORY so a just-``os.replace``'d entry survives a
+    machine crash, not only a process crash — POSIX persists the rename
+    itself only once the directory inode reaches disk.  Best-effort on
+    filesystems/platforms that refuse to fsync directories (the rename
+    is still process-crash-atomic there)."""
+    try:
+        fd = os.open(dirpath or ".", os.O_RDONLY)
+    except OSError:  # pragma: no cover — unopenable dir (exotic fs)
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover — fs refuses directory fsync
+        pass
+    finally:
+        os.close(fd)
+
+
 def _batch_rows(batch, default: Optional[int] = None) -> Optional[int]:
     """Row count of one in-flight batch, tolerant of prepared operands.
 
@@ -836,7 +854,14 @@ class StreamCursor:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"version": 1, "rows_done": self.rows_done}, f)
+            # fsync data BEFORE the rename: os.replace alone is atomic
+            # against a PROCESS crash, but a machine crash could persist
+            # the rename while the new file's blocks never hit disk —
+            # surfacing an empty/stale cursor (ISSUE 6 satellite)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)  # atomic: a crash never leaves a torn cursor
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
 
     @classmethod
     def load(cls, path: str) -> "StreamCursor":

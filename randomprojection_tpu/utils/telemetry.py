@@ -159,6 +159,13 @@ class EVENTS:
     SIMHASH_TOPK_DENSE_FALLBACK = "simhash.topk_dense_fallback"
     SERVE_TOPK_BATCH = "serve.topk_batch"
     SERVE_TOPK_ERROR = "serve.topk.error"
+    # durable index lifecycle (snapshot/restore + crash recovery)
+    INDEX_SNAPSHOT_SAVE = "index.snapshot.save"
+    INDEX_SNAPSHOT_LOAD = "index.snapshot.load"
+    INDEX_COMPACT = "index.compact"
+    RECOVER_RESUME = "recover.resume"
+    RECOVER_CHECKSUM_MISMATCH = "recover.checksum_mismatch"
+    RECOVER_ORPHAN_CHUNK = "recover.orphan_chunk"
 
     # runtime-completed name families.  ``*_FAMILY`` constants are the
     # prefixes callers build on (today: the per-kernel-path hash counter

@@ -60,6 +60,7 @@ DEGRADED_EVENTS = (
     EVENTS.STREAM_STAGED_ERROR,
     EVENTS.STREAM_STAGED_SHUTDOWN_TIMEOUT,
     EVENTS.SERVE_TOPK_ERROR,
+    EVENTS.RECOVER_CHECKSUM_MISMATCH,
 )
 
 
@@ -136,6 +137,8 @@ def build_report(path: str) -> dict:
     empty_roots = 0
     t_min, t_max = None, None
     child_wall = 0.0
+    recover_resumes: list = []
+    orphan_chunks = 0
 
     for e in read_events(path):
         n_events += 1
@@ -197,6 +200,15 @@ def build_report(path: str) -> dict:
                 queue_capacity = e.get("capacity")
         elif name == EVENTS.HASH_BATCH and e.get("path") == "python":
             hash_python += 1
+        elif name == EVENTS.RECOVER_RESUME:
+            # a durable ingest resumed from its committed cursor: the
+            # replayed row range is the crash's footprint, on the record
+            recover_resumes.append({
+                "rows_done": e.get("rows_done"),
+                "replay_rows": e.get("replay_rows"),
+            })
+        elif name == EVENTS.RECOVER_ORPHAN_CHUNK:
+            orphan_chunks += 1
 
     # traces whose root never ended: their buffered children are orphaned
     # work of a crashed run — count the traces as incomplete
@@ -266,6 +278,14 @@ def build_report(path: str) -> dict:
         "queue_depth": queue,
         "degraded": degraded,
         "unregistered_events": unregistered,
+        "recovery": (
+            {
+                "resumes": recover_resumes,
+                "orphan_chunks_swept": orphan_chunks,
+            }
+            if (recover_resumes or orphan_chunks)
+            else None
+        ),
     }
 
 
@@ -338,6 +358,20 @@ def render_report(report: dict) -> str:
             if worst else "no degraded paths recorded"
         )
     )
+    rec = report.get("recovery")
+    if rec:
+        lines.append("")
+        lines.append("crash recovery:")
+        for r in rec["resumes"]:
+            lines.append(
+                f"  resumed at rows_done={r['rows_done']} "
+                f"(replayed {r['replay_rows']} uncommitted rows)"
+            )
+        if rec["orphan_chunks_swept"]:
+            lines.append(
+                f"  {rec['orphan_chunks_swept']} orphan spill file(s) "
+                "swept (uncommitted chunk writes from the crash)"
+            )
     unreg = report.get("unregistered_events")
     if unreg:
         lines.append(

@@ -1,0 +1,15 @@
+"""RP02 fixture (ISSUE 6 satellite): a recovery path emitting an event
+name that is NOT in ``telemetry.EVENTS`` — the drift the central
+registry exists to catch.  Linted against the REAL registry (unlike
+``rp02_bad.py``'s synthetic one), so it also proves the shipped
+registry does not silently grow a matching name."""
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+
+def resume_with_unregistered_event(path, rows_done):
+    # VIOLATION: a recovery event dodging the registry — invisible to
+    # trace_report's recovery section and the degraded audit
+    telemetry.emit("recover.rogue_replay", path=path, rows_done=rows_done)
+    # ok: the registered resume event
+    telemetry.emit(EVENTS.RECOVER_RESUME, path=path, rows_done=rows_done)

@@ -1,0 +1,559 @@
+"""Durable index lifecycle (ISSUE 6): snapshot/restore with checksummed
+versioned manifests, tombstones + compaction, exactly-once crash
+recovery of a durable ingest, and the subprocess SIGKILL fault
+harness asserting bit-identical recovery at every injection point."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu import durable
+from randomprojection_tpu.durable import (
+    DurableIngest,
+    check_coverage,
+    crash_smoke,
+    demo_ingest,
+    load_index,
+    read_manifest,
+    run_child,
+    save_index,
+    verify_snapshot,
+)
+from randomprojection_tpu.models.sketch import (
+    SimHashIndex,
+    SignRandomProjection,
+    pairwise_hamming,
+    _host_topk_select,
+)
+from randomprojection_tpu.streaming import CallableSource, FaultInjectionSource
+from randomprojection_tpu.utils import telemetry
+
+
+def _codes(n=300, nbytes=8, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(n, nbytes), dtype=np.uint8
+    )
+
+
+def _queries(n=7, nbytes=8, seed=99):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(n, nbytes), dtype=np.uint8
+    )
+
+
+def _filtered_reference(q, codes, dead_ids, m):
+    """Host reference: brute-force distances with tombstoned columns
+    forced to lose, then the shared (distance, lower-id) selection."""
+    D = pairwise_hamming(q, codes).astype(np.int64)
+    D[:, np.asarray(dead_ids)] = 10**6
+    return _host_topk_select(D, m)
+
+
+# -- tombstones + compaction -------------------------------------------------
+
+
+def test_delete_filters_query_topk():
+    codes, q = _codes(), _queries()
+    idx = SimHashIndex(codes[:120])
+    idx.add(codes[120:])
+    d0, i0 = idx.query_topk(q, 5)
+    # tombstone the top hit of query 0 plus assorted ids across chunks
+    dead = sorted({0, 5, int(i0[0, 0]), 250})
+    assert idx.delete(dead) == len(dead)
+    assert idx.n_deleted == len(dead)
+    assert idx.n_live == codes.shape[0] - len(dead)
+    ref_d, ref_i = _filtered_reference(q, codes, dead, 5)
+    d1, i1 = idx.query_topk(q, 5)
+    np.testing.assert_array_equal(d1, ref_d)
+    np.testing.assert_array_equal(i1, ref_i)
+    assert not np.isin(i1, dead).any()
+    # idempotent: re-deleting counts zero and changes nothing
+    assert idx.delete([dead[0]]) == 0
+    d2, i2 = idx.query_topk(q, 5)
+    np.testing.assert_array_equal(i2, i1)
+
+
+def test_delete_duplicate_ids_count_once(tmp_path):
+    """Regression: duplicate ids in ONE delete call must count once —
+    over-counting skewed n_deleted/n_live and produced snapshots whose
+    manifest deleted-count disagreed with their own bitmap (unloadable)."""
+    idx = SimHashIndex(_codes(20))
+    assert idx.delete([3, 3, 3, 7]) == 2
+    assert idx.n_deleted == 2 and idx.n_live == 18
+    idx.save(str(tmp_path))
+    assert SimHashIndex.load(str(tmp_path)).n_deleted == 2
+
+
+def test_delete_validation_and_empty_live():
+    idx = SimHashIndex(_codes(10))
+    with pytest.raises(ValueError, match="in \\[0, 10\\)"):
+        idx.delete([10])
+    with pytest.raises(ValueError, match="in \\[0, 10\\)"):
+        idx.delete([-1])
+    with pytest.raises(ValueError, match="integers"):
+        idx.delete([0.5])
+    assert idx.delete([]) == 0
+    idx.delete(np.arange(10))
+    assert idx.n_live == 0
+    with pytest.raises(ValueError, match="all deleted"):
+        idx.query_topk(_queries(2), 3)
+
+
+def test_m_eff_counts_live_codes_only():
+    codes, q = _codes(20), _queries(3)
+    idx = SimHashIndex(codes)
+    idx.delete(np.arange(15))  # 5 live
+    d, i = idx.query_topk(q, 12)  # m > n_live: width is n_live
+    assert d.shape == (3, 5) and i.shape == (3, 5)
+    assert set(i.ravel()) <= set(range(15, 20))
+
+
+def test_dense_fallback_filters_tombstones(monkeypatch):
+    import randomprojection_tpu.models.sketch as sk
+
+    codes, q = _codes(60), _queries(4)
+    idx = SimHashIndex(codes)
+    dead = [2, 17, 40]
+    idx.delete(dead)
+    ref_d, ref_i = _filtered_reference(q, codes, dead, 6)
+    # force the dense query()+host-selection path
+    monkeypatch.setattr(sk, "_topk_key_fits_int32", lambda *a: False)
+    d, i = idx.query_topk(q, 6)
+    np.testing.assert_array_equal(d, ref_d)
+    np.testing.assert_array_equal(i, ref_i)
+
+
+def test_compact_folds_tombstones_and_merges_chunks():
+    codes, q = _codes(), _queries()
+    idx = SimHashIndex(codes[:100])
+    idx.add(codes[100:200])
+    idx.add(codes[200:])
+    dead = [0, 150, 299]
+    idx.delete(dead)
+    ref_d, ref_i = _filtered_reference(q, codes, dead, 5)
+    mapping = idx.compact()
+    assert len(idx._chunks) == 1
+    assert idx.n_codes == 297 and idx.n_deleted == 0
+    assert mapping.shape == (297,)
+    d, i = idx.query_topk(q, 5)
+    np.testing.assert_array_equal(d, ref_d)
+    # new ids translate back to the old id space through the mapping
+    np.testing.assert_array_equal(mapping[i], ref_i)
+
+
+def test_compact_without_tombstones_is_identity_mapping():
+    codes, q = _codes(50), _queries(3)
+    idx = SimHashIndex(codes[:20])
+    idx.add(codes[20:])
+    d0, i0 = idx.query_topk(q, 4)
+    mapping = idx.compact()
+    np.testing.assert_array_equal(mapping, np.arange(50))
+    d1, i1 = idx.query_topk(q, 4)
+    np.testing.assert_array_equal(d0, d1)
+    np.testing.assert_array_equal(i0, i1)
+
+
+# -- snapshot/restore --------------------------------------------------------
+
+
+def test_snapshot_round_trip_multi_chunk_with_tombstones(tmp_path):
+    codes, q = _codes(), _queries()
+    idx = SimHashIndex(codes[:100], n_bits=61)  # ragged bits round-trip
+    idx.add(codes[100:220])
+    idx.add(codes[220:])
+    idx.delete([3, 7, 150])
+    manifest = idx.save(str(tmp_path))
+    assert manifest["format_version"] == durable.INDEX_FORMAT_VERSION
+    assert len(manifest["chunks"]) == 3
+    assert manifest["tombstones"]["deleted"] == 3
+    check_coverage(manifest)
+    idx2 = SimHashIndex.load(str(tmp_path))
+    assert idx2.n_codes == 300 and idx2.n_deleted == 3
+    assert idx2.n_bits == 61 and idx2.n_bytes == 8
+    assert len(idx2._chunks) == 3  # chunk structure round-trips
+    assert [c.n for c in idx2._chunks] == [c.n for c in idx._chunks]
+    da, ia = idx.query_topk(q, 6)
+    db, ib = idx2.query_topk(q, 6)
+    np.testing.assert_array_equal(da, db)
+    np.testing.assert_array_equal(ia, ib)
+
+
+def test_snapshot_resave_bumps_generation_and_sweeps(tmp_path):
+    idx = SimHashIndex(_codes(40))
+    m0 = save_index(idx, str(tmp_path))
+    assert m0["generation"] == 0
+    idx.add(_codes(10, seed=5))
+    m1 = save_index(idx, str(tmp_path))
+    assert m1["generation"] == 1
+    # only the new generation's files remain on disk
+    spills = sorted(
+        f for f in os.listdir(tmp_path) if f.endswith(".npy")
+    )
+    assert spills == sorted(e["file"] for e in m1["chunks"])
+    assert load_index(str(tmp_path)).n_codes == 50
+
+
+def test_corrupted_chunk_fails_checksum_loudly(tmp_path):
+    idx = SimHashIndex(_codes(64))
+    manifest = save_index(idx, str(tmp_path))
+    path = tmp_path / manifest["chunks"][0]["file"]
+    raw = bytearray(path.read_bytes())
+    raw[-1] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    tel = str(tmp_path / "tel.jsonl")
+    telemetry.configure(tel)
+    try:
+        with pytest.raises(ValueError, match="checksum"):
+            load_index(str(tmp_path))
+    finally:
+        telemetry.shutdown()
+    events = [
+        e for e in telemetry.read_events(tel)
+        if e["event"] == "recover.checksum_mismatch"
+    ]
+    assert len(events) == 1
+    assert events[0]["file"] == manifest["chunks"][0]["file"]
+    # the operational face reports it without raising, and exits dirty
+    status = verify_snapshot(str(tmp_path))
+    assert not status["ok"]
+    assert [c["file"] for c in status["corrupt"]] == [
+        manifest["chunks"][0]["file"]
+    ]
+
+
+def test_unknown_manifest_version_rejected(tmp_path):
+    idx = SimHashIndex(_codes(8))
+    save_index(idx, str(tmp_path))
+    mpath = tmp_path / durable.MANIFEST_NAME
+    m = json.loads(mpath.read_text())
+    m["format_version"] = 99
+    mpath.write_text(json.dumps(m))
+    with pytest.raises(ValueError, match="version 99"):
+        load_index(str(tmp_path))
+    status = verify_snapshot(str(tmp_path))
+    assert not status["ok"] and "version 99" in status["error"]
+
+
+def test_check_coverage_rejects_gaps_and_overlaps():
+    good = {"n_codes": 10, "chunks": [
+        {"file": "a", "rows": 4, "row0": 0},
+        {"file": "b", "rows": 6, "row0": 4},
+    ]}
+    assert check_coverage(good) == 10
+    gap = {"n_codes": 10, "chunks": [
+        {"file": "a", "rows": 4, "row0": 0},
+        {"file": "b", "rows": 4, "row0": 6},
+    ]}
+    with pytest.raises(ValueError, match="gaps or overlaps"):
+        check_coverage(gap)
+    short = {"n_codes": 12, "chunks": good["chunks"]}
+    with pytest.raises(ValueError, match="n_codes=12"):
+        check_coverage(short)
+
+
+def test_snapshot_round_trips_across_processes(tmp_path):
+    """Acceptance: save/load round-trips a multi-chunk index WITH
+    tombstones across processes — a fresh interpreter loads the
+    snapshot and answers queries identically."""
+    import subprocess
+    import sys
+
+    codes, q = _codes(), _queries()
+    idx = SimHashIndex(codes[:150])
+    idx.add(codes[150:])
+    idx.delete([1, 42, 200])
+    idx.save(str(tmp_path / "snap"))
+    d, i = idx.query_topk(q, 5)
+    qf, of = str(tmp_path / "q.npy"), str(tmp_path / "out.npz")
+    np.save(qf, q)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", (
+            "import numpy as np\n"
+            "from randomprojection_tpu.models.sketch import SimHashIndex\n"
+            f"idx = SimHashIndex.load({str(tmp_path / 'snap')!r})\n"
+            "assert idx.n_deleted == 3 and len(idx._chunks) == 2\n"
+            f"d, i = idx.query_topk(np.load({qf!r}), 5)\n"
+            f"np.savez({of!r}, d=d, i=i)\n"
+        )],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = np.load(of)
+    np.testing.assert_array_equal(out["d"], d)
+    np.testing.assert_array_equal(out["i"], i)
+
+
+# -- cursor durability (satellite) -------------------------------------------
+
+
+def test_stream_cursor_save_fsyncs_file_and_directory(
+    tmp_path, monkeypatch
+):
+    """A machine crash (not just a process crash) must not surface an
+    empty/stale cursor: the temp file is fsync'd before the rename and
+    the directory after it."""
+    import randomprojection_tpu.streaming as streaming
+
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        streaming.os, "fsync", lambda fd: (synced.append(fd),
+                                           real_fsync(fd))[1]
+    )
+    path = str(tmp_path / "cursor.json")
+    streaming.StreamCursor(rows_done=64).save(path)
+    # one fsync for the temp file's data, one for the directory entry
+    assert len(synced) >= 2
+    assert streaming.StreamCursor.load(path).rows_done == 64
+    assert not os.path.exists(path + ".tmp")
+
+
+# -- durable ingest ----------------------------------------------------------
+
+
+def _toy_stream(rows=96, batch_rows=32, d=8, bits=32, seed=1):
+    def read(lo, hi):
+        rng = np.random.default_rng([seed, lo])
+        return rng.standard_normal((hi - lo, d), dtype=np.float32)
+
+    source = CallableSource(read, rows, d, dtype=np.float32,
+                            batch_rows=batch_rows)
+    est = SignRandomProjection(bits, random_state=seed, backend="numpy")
+    est.fit_source(source)
+    return est, source
+
+
+def test_durable_ingest_fresh_then_idempotent(tmp_path):
+    est, source = _toy_stream()
+    path = str(tmp_path / "run")
+    idx = DurableIngest(path).run(est, source)
+    assert idx.n_codes == 96 and len(idx._chunks) == 3
+    manifest = read_manifest(path)
+    assert manifest["ingest"]["rows_done"] == 96
+    check_coverage(manifest)
+    # re-running a completed ingest replays nothing and changes nothing
+    shas = [e["sha256"] for e in manifest["chunks"]]
+    idx2 = DurableIngest(path).run(est, source)
+    assert idx2.n_codes == 96
+    assert [e["sha256"] for e in read_manifest(path)["chunks"]] == shas
+
+
+def test_durable_ingest_rejects_non_code_estimators(tmp_path):
+    from randomprojection_tpu import GaussianRandomProjection
+    from randomprojection_tpu.streaming import ArraySource
+
+    X = np.zeros((8, 4), np.float32)
+    est = GaussianRandomProjection(2, random_state=0, backend="numpy")
+    source = ArraySource(X, 4)
+    est.fit_source(source)
+    with pytest.raises(ValueError, match="uint8"):
+        DurableIngest(str(tmp_path / "x")).run(est, source)
+
+
+def test_durable_ingest_rejects_mismatched_resume(tmp_path):
+    est, source = _toy_stream(bits=32)
+    path = str(tmp_path / "run")
+    DurableIngest(path).run(est, source)
+    est2, source2 = _toy_stream(bits=64)
+    with pytest.raises(ValueError, match="mix two projections"):
+        DurableIngest(path).run(est2, source2)
+    # a plain snapshot dir is not an ingest dir
+    snap = str(tmp_path / "snap")
+    save_index(SimHashIndex(_codes(4, nbytes=4)), snap)
+    with pytest.raises(ValueError, match="not a durable\\s+ingest"):
+        DurableIngest(snap).run(est, source)
+
+
+def test_durable_ingest_rejects_same_shape_different_projection(tmp_path):
+    """Same bits/bytes but a different SEED is a different projection:
+    the manifest records the estimator fingerprint and a mismatched
+    resume is refused instead of silently mixing matrices."""
+    est, source = _toy_stream(rows=160, batch_rows=32, seed=1)
+    path = str(tmp_path / "run")
+    faulty = FaultInjectionSource(source, fail_after_batches=2)
+    with pytest.raises(FaultInjectionSource.InjectedFault):
+        DurableIngest(path).run(est, faulty)
+    manifest = read_manifest(path)
+    assert manifest["ingest"]["estimator"]["class"] == (
+        "SignRandomProjection"
+    )
+    other = SignRandomProjection(32, random_state=2, backend="numpy")
+    other.fit_source(source)
+    with pytest.raises(ValueError, match="mix two projections"):
+        DurableIngest(path).run(other, source)
+
+
+def test_verify_snapshot_reports_malformed_manifest_body(tmp_path):
+    save_index(SimHashIndex(_codes(8)), str(tmp_path))
+    mpath = tmp_path / durable.MANIFEST_NAME
+    m = json.loads(mpath.read_text())
+    del m["chunks"]  # right version/kind, truncated body
+    mpath.write_text(json.dumps(m))
+    status = verify_snapshot(str(tmp_path))
+    assert not status["ok"]
+    assert "malformed manifest" in status["error"]
+
+
+def test_durable_ingest_crash_resume_bit_identical(tmp_path):
+    """In-process crash (raised mid-stream) → resume replays exactly
+    the uncommitted row ranges; manifest + codes bit-identical to an
+    uninterrupted run, with recover.resume on the telemetry spine."""
+    est, source = _toy_stream(rows=160, batch_rows=32)
+    clean = str(tmp_path / "clean")
+    DurableIngest(clean).run(est, source)
+    clean_manifest = read_manifest(clean)
+
+    crashed = str(tmp_path / "crashed")
+    faulty = FaultInjectionSource(source, fail_after_batches=3)
+    with pytest.raises(FaultInjectionSource.InjectedFault):
+        DurableIngest(crashed).run(est, faulty)
+    partial = read_manifest(crashed)
+    assert 0 < partial["ingest"]["rows_done"] < 160
+    check_coverage(partial)
+
+    tel = str(tmp_path / "tel.jsonl")
+    telemetry.configure(tel)
+    try:
+        faulty.disarm()
+        idx = DurableIngest(crashed).run(est, faulty)
+    finally:
+        telemetry.shutdown()
+    assert idx.n_codes == 160
+    recovered = read_manifest(crashed)
+    check_coverage(recovered)
+    assert [e["sha256"] for e in recovered["chunks"]] == [
+        e["sha256"] for e in clean_manifest["chunks"]
+    ]
+    resumes = [
+        e for e in telemetry.read_events(tel)
+        if e["event"] == "recover.resume"
+    ]
+    assert len(resumes) == 1
+    assert resumes[0]["rows_done"] == partial["ingest"]["rows_done"]
+    assert resumes[0]["replay_rows"] == 160 - resumes[0]["rows_done"]
+    # the doctor consumes the resume into its recovery section
+    from randomprojection_tpu.utils.trace_report import build_report
+
+    report = build_report(tel)
+    assert report["recovery"]["resumes"] == [{
+        "rows_done": resumes[0]["rows_done"],
+        "replay_rows": resumes[0]["replay_rows"],
+    }]
+
+
+def test_durable_ingest_commit_every_amortizes(tmp_path):
+    est, source = _toy_stream(rows=96, batch_rows=16)
+    path = str(tmp_path / "run")
+    DurableIngest(path, commit_every_batches=3).run(est, source)
+    manifest = read_manifest(path)
+    assert manifest["ingest"]["rows_done"] == 96
+    check_coverage(manifest)
+    assert len(manifest["chunks"]) == 6  # still one spill per batch
+
+
+def test_durable_ingest_compaction_bounds_chunks(tmp_path):
+    est, source = _toy_stream(rows=96, batch_rows=16)
+    compacted = str(tmp_path / "compacted")
+    idx = DurableIngest(
+        compacted, compact_after_chunks=3
+    ).run(est, source)
+    manifest = read_manifest(compacted)
+    assert manifest["generation"] >= 1
+    assert len(manifest["chunks"]) < 6
+    check_coverage(manifest)
+    # only referenced spills remain; content identical to the plain run
+    spills = sorted(
+        f for f in os.listdir(compacted) if f.endswith(".npy")
+    )
+    assert spills == sorted(e["file"] for e in manifest["chunks"])
+    plain = str(tmp_path / "plain")
+    DurableIngest(plain).run(est, source)
+    np.testing.assert_array_equal(
+        durable._codes_of(compacted), durable._codes_of(plain)
+    )
+    q = _queries(4, nbytes=4)  # 32-bit codes
+    da, ia = idx.query_topk(q, 5)
+    db, ib = load_index(plain).query_topk(q, 5)
+    np.testing.assert_array_equal(da, db)
+    np.testing.assert_array_equal(ia, ib)
+
+
+# -- the subprocess SIGKILL fault harness ------------------------------------
+
+
+def test_process_kill_matrix_recovers_bit_identical(tmp_path):
+    """THE acceptance gate: SIGKILL a real subprocess ingest at every
+    injected point (mid-batch, post-yield pre-ack, mid-snapshot-rename),
+    restart it, and assert no row range was dropped or double-committed
+    and the recovered index — codes, manifest checksums, query results —
+    is bit-identical to an uninterrupted run."""
+    verdict = crash_smoke(str(tmp_path), rows=128, batch_rows=32)
+    assert verdict["ok"], json.dumps(verdict, indent=1)
+    assert {c["kill_at"] for c in verdict["cases"]} == set(
+        durable.KILL_POINTS
+    )
+    for case in verdict["cases"]:
+        assert case["crash_returncode"] == -signal.SIGKILL
+        assert case["resume_returncode"] == 0
+        assert case["bit_identical_codes"]
+        assert case["manifest_chunks_identical"]
+        assert case["query_results_match"]
+
+
+def test_kill_env_spec_fires_at_nth_hit(tmp_path):
+    """The injection hook itself: a child with RP_DURABLE_KILL dies by
+    SIGKILL (uncatchable — rc -9, not an exception path) exactly at the
+    named point, leaving a committed prefix behind."""
+    path = str(tmp_path / "run")
+    proc = run_child(path, rows=128, batch_rows=32,
+                     kill="post-yield-pre-ack@2")
+    assert proc.returncode == -signal.SIGKILL
+    manifest = read_manifest(path)
+    # one batch committed (the kill fired during the second commit),
+    # and the second batch's chunk file is an uncommitted orphan
+    assert manifest["ingest"]["rows_done"] == 32
+    orphans = durable._scan_orphans(path, manifest)
+    assert len(orphans) == 1
+
+
+# -- cli recover -------------------------------------------------------------
+
+
+def test_cli_recover_status_and_child(tmp_path, capsys):
+    from randomprojection_tpu import cli
+
+    path = str(tmp_path / "run")
+    rc = cli.main([
+        "recover", "--child", path, "--rows", "64", "--batch-rows", "32",
+        "--d", "8", "--bits", "32",
+    ])
+    assert rc == 0
+    child = json.loads(capsys.readouterr().out)
+    assert child["rows_done"] == 64 and child["chunks"] == 2
+    rc = cli.main(["recover", path])
+    assert rc == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["ok"] and status["rows_done"] == 64
+    assert status["chunks"] == 2 and status["coverage_ok"]
+    # corruption → non-zero exit, corrupt file named
+    manifest = read_manifest(path)
+    f = tmp_path / "run" / manifest["chunks"][1]["file"]
+    raw = bytearray(f.read_bytes())
+    raw[-1] ^= 0xFF
+    f.write_bytes(bytes(raw))
+    rc = cli.main(["recover", path])
+    assert rc == 1
+    status = json.loads(capsys.readouterr().out)
+    assert not status["ok"]
+    assert status["corrupt"][0]["file"] == manifest["chunks"][1]["file"]
+
+
+def test_cli_recover_requires_dir(capsys):
+    from randomprojection_tpu import cli
+
+    with pytest.raises(SystemExit, match="requires DIR"):
+        cli.main(["recover"])

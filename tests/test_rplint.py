@@ -116,6 +116,26 @@ def test_rp06_silent_swallow():
     assert _lint_fixture("rp06_bad.py") == []  # outside the pipeline set
 
 
+def test_rp02_unregistered_recovery_event_fixture():
+    """ISSUE 6 satellite: an unregistered ``recover.*`` emit is caught
+    against the REAL shipped registry — the recovery namespace has no
+    family prefix, so each event must be individually registered, and
+    the registered one in the same fixture stays clean."""
+    real = rplint.load_event_registry(
+        open(os.path.join(
+            rplint.package_root(), "utils", "telemetry.py"
+        )).read()
+    )
+    assert real is not None and real.knows("recover.resume")
+    assert not real.knows("recover.rogue_replay")
+    active, suppressed = _split(
+        _lint_fixture("rp02_recover_bad.py", registry=real)
+    )
+    assert [f.rule for f in active] == ["RP02"]
+    assert "'recover.rogue_replay'" in active[0].message
+    assert not suppressed
+
+
 def test_rp04_zero_and_negative_maxsize_are_unbounded():
     """Python treats any maxsize <= 0 as unbounded — every spelling of
     that must trip RP04, not just the bare constructor."""

@@ -1,6 +1,7 @@
 """Persistence, observability, and CLI tests (SURVEY.md §6 subsystems)."""
 
 import json
+import os
 import subprocess
 import sys
 
@@ -74,6 +75,35 @@ def test_load_lazy_model_refuses_foreign_backend(tmp_path):
     }))
     with pytest.raises(ValueError, match="cannot be loaded"):
         load_model(str(p), backend="numpy")
+
+
+def test_matrix_bundle_roundtrip_and_missing_npz_pointed_error(tmp_path):
+    """ISSUE 6 satellite: both directions of the include_matrix round
+    trip, and a payload promising a bundle whose sibling .npz is gone
+    fails with a pointed error naming the expected path — not an opaque
+    downstream exception."""
+    X = np.random.default_rng(1).normal(size=(30, 64)).astype(np.float32)
+    est = GaussianRandomProjection(8, random_state=2, backend="numpy").fit(X)
+    Y = np.asarray(est.transform(X))
+    p = str(tmp_path / "m.json")
+    # direction 1: save with bundle -> load (bundle present) -> identical
+    save_model(est, p, include_matrix=True)
+    est2 = load_model(p, backend="numpy")
+    np.testing.assert_array_equal(np.asarray(est2.transform(X)), Y)
+    # direction 2: the reloaded estimator re-saves to an equivalent
+    # artifact a fresh load also reproduces from
+    p2 = str(tmp_path / "m2.json")
+    save_model(est2, p2, include_matrix=True)
+    b1, b2 = np.load(p + ".npz"), np.load(p2 + ".npz")
+    np.testing.assert_array_equal(b1["components"], b2["components"])
+    np.testing.assert_array_equal(
+        np.asarray(load_model(p2, backend="numpy").transform(X)), Y
+    )
+    # missing sibling bundle: pointed failure naming the expected path
+    os.remove(p + ".npz")
+    with pytest.raises(ValueError, match="include_matrix") as ei:
+        load_model(p)
+    assert str(tmp_path / "m.json.npz") in str(ei.value)
 
 
 def test_load_rejects_bad_version(tmp_path):

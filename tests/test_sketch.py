@@ -536,6 +536,31 @@ def test_topk_server_lifecycle_and_validation():
     ]
 
 
+def test_topk_server_submit_after_close_fails_fast():
+    """ISSUE 6 satellite regression: submit()/query() after close()
+    raise a clear 'server closed' RuntimeError instead of enqueueing
+    into a dead dispatcher, and a closed server cannot be start()ed
+    back into a queue whose sentinel already drained."""
+    from randomprojection_tpu.models.sketch import TopKServer
+
+    idx, q = _serving_fixture(n_codes=200, n_add=0, nq=8)
+    srv = TopKServer(idx, 2, max_delay_s=0.0)
+    srv.close()
+    with pytest.raises(RuntimeError, match="server closed"):
+        srv.submit(q[:1])
+    with pytest.raises(RuntimeError, match="server closed"):
+        srv.query(q[:1])
+    with pytest.raises(RuntimeError, match="server closed"):
+        srv.start()
+    # a never-started server closes cleanly and still refuses submits
+    srv2 = TopKServer(idx, 2, start=False)
+    srv2.close()
+    with pytest.raises(RuntimeError, match="server closed"):
+        srv2.submit(q[:1])
+    with pytest.raises(RuntimeError, match="server closed"):
+        srv2.start()
+
+
 def test_topk_server_bounded_queue_rejects_when_stalled():
     """The submit queue is bounded (ISSUE r10): with the dispatcher not
     draining, the max_pending+1'th submit fails fast instead of growing
