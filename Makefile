@@ -11,6 +11,10 @@
 #                      syncs, thread hygiene, ops/ determinism, silent
 #                      swallows — non-zero on any unsuppressed finding
 #   make tier1         just the test suite
+#   make kernel-smoke  interpreter-mode fused top-k kernel (ISSUE 7) on
+#                      a toy index, parity-asserted against the scan
+#                      path and host brute force — run before tier-1 so
+#                      a broken serving kernel fails fast
 #   make recover-smoke subprocess kill/resume harness at toy shapes:
 #                      SIGKILL the durable ingest at every injected
 #                      point, restart, assert the recovered index is
@@ -24,12 +28,28 @@ SHELL := /bin/bash
 PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
-.PHONY: verify lint tier1 recover-smoke doctor-smoke
+.PHONY: verify lint tier1 kernel-smoke recover-smoke doctor-smoke
 
-verify: lint recover-smoke tier1 doctor-smoke
+verify: lint kernel-smoke recover-smoke tier1 doctor-smoke
 
 lint:
 	$(PYTHON) -m randomprojection_tpu lint
+
+kernel-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import numpy as np; \
+	from randomprojection_tpu.models import sketch as sk; \
+	rng = np.random.default_rng(0); \
+	B = rng.integers(0, 256, size=(1500, 8), dtype=np.uint8); \
+	A = rng.integers(0, 256, size=(32, 8), dtype=np.uint8); \
+	idx = sk.SimHashIndex(B); \
+	assert idx._chunk_impl(32, 1500, 7) == 'fused', 'fused not default'; \
+	d, i = idx.query_topk(A, 7); \
+	rd, ri = sk.topk_bruteforce(A, B, 7); \
+	assert (d == rd).all() and (i == ri).all(), 'fused/brute mismatch'; \
+	scan = sk.SimHashIndex(B, topk_impl='scan'); \
+	ds, js = scan.query_topk(A, 7); \
+	assert (ds == rd).all() and (js == ri).all(), 'scan/brute mismatch'; \
+	print('kernel-smoke OK: fused (interpret) == scan == brute force')"
 
 recover-smoke:
 	rm -rf $(SMOKE_DIR)_recover && mkdir -p $(SMOKE_DIR)_recover

@@ -79,7 +79,12 @@ def main():
     import randomprojection_tpu.streaming as streaming
     import randomprojection_tpu.parallel as parallel
     from randomprojection_tpu.analysis import rplint
-    from randomprojection_tpu.ops import hashing, pallas_kernels, split_matmul
+    from randomprojection_tpu.ops import (
+        hashing,
+        pallas_kernels,
+        split_matmul,
+        topk_kernels,
+    )
     from randomprojection_tpu.parallel import distributed
     from randomprojection_tpu.utils import observability, telemetry, trace_report
 
@@ -91,6 +96,7 @@ def main():
         ("`randomprojection_tpu.parallel.distributed`", distributed),
         ("`randomprojection_tpu.ops.hashing`", hashing),
         ("`randomprojection_tpu.ops.pallas_kernels`", pallas_kernels),
+        ("`randomprojection_tpu.ops.topk_kernels`", topk_kernels),
         ("`randomprojection_tpu.ops.split_matmul`", split_matmul),
         ("`randomprojection_tpu.utils.observability`", observability),
         ("`randomprojection_tpu.utils.telemetry`", telemetry),

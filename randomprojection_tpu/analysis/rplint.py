@@ -106,6 +106,7 @@ HOT_MODULES = (
     "streaming.py",
     "backends/jax_backend.py",
     "ops/pallas_kernels.py",
+    "ops/topk_kernels.py",
     "models/sketch.py",
 )
 # RP06: modules on the pipeline/serving path where a swallowed error

@@ -256,6 +256,11 @@ def build_parser():
     q.add_argument("--server-delay-ms", type=float, default=2.0,
                    help="TopKServer max wait for stragglers once a "
                         "request is in hand")
+    q.add_argument("--topk-impl", default="auto",
+                   choices=["auto", "fused", "scan"],
+                   help="query_topk device path: 'auto' (default) serves "
+                        "via the fused Pallas kernel where plannable, "
+                        "'scan' pins the retained lax.scan reference path")
     q.add_argument("--seed", type=int, default=0)
     _add_observability(q)
 
@@ -629,7 +634,7 @@ def cmd_topk_bench(args):
         pool[i * args.request_rows : (i + 1) * args.request_rows]
         for i in range(n_requests)
     ]
-    index = SimHashIndex(codes)
+    index = SimHashIndex(codes, topk_impl=args.topk_impl)
     index.query_topk(requests[0], args.m)  # warm compile
 
     t0 = time.perf_counter()
@@ -674,6 +679,10 @@ def cmd_topk_bench(args):
         "request_rows": args.request_rows,
         "requests": len(requests),
         "clients": args.clients,
+        "topk_impl": index._chunk_impl(
+            args.request_rows, index._chunks[0].b.shape[0],
+            min(args.m, args.index_codes),
+        ),
         "direct_queries_per_s": round(direct_qps, 1),
         "server_queries_per_s": round(server_qps, 1),
         "server_speedup": round(server_qps / direct_qps, 2),

@@ -209,27 +209,20 @@ def topk_bruteforce(A, B, m: int):
     return _host_topk_select(pairwise_hamming(A, B), m)
 
 
-def _topk_block_clamp(blk: int, m_c: int, sentinel: int) -> int:
-    """Shrink the top-k scan block until the packed selection key
-    ``dist·(m_c+blk) + position`` fits int32 — wide codes (large
-    ``sentinel`` = bits+1) keep working at the same result envelope, just
-    with more scan steps."""
+def _scan_clamp(blk: int, m_c: int, sentinel: int):
+    """Packed-key bound of the RETAINED scan path only.  The scan's
+    selection packs ``dist·(m_c+blk) + position`` into one int32, so its
+    block shrinks until the key fits; when even the floor block cannot
+    represent the request the scan path cannot serve it.  The fused
+    Pallas kernel (``ops/topk_kernels.py``) — the default single-device
+    path since ISSUE 7 — keeps distance and index as SEPARATE carries
+    and has no such ceiling; this bound now matters only for the mesh
+    path, explicit ``topk_impl='scan'``, and the VMEM-OOM degraded
+    retry.  Returns ``(clamped_blk, fits)``."""
     while blk > 8 and (sentinel + 1) * (m_c + blk) >= 2**31:
         blk //= 2
-    return blk
-
-
-def _topk_key_fits_int32(n_bits_total: int, m_c: int, row_block: int) -> bool:
-    """Whether the on-device top-k's packed int32 selection key can
-    represent a request after ``_topk_block_clamp`` bottoms out —
-    requires ``(n_bits+2)·(m_c+blk) < 2**31`` at the clamped block.  When
-    it cannot (very wide codes, or ``m ≳ 2^31/(n_bits+2)`` — ~8.3M at
-    256-bit codes), ``query_topk`` falls back to the dense ``query()`` +
-    host-selection path instead of raising (ADVICE r5)."""
-    sentinel = n_bits_total + 1
-    blk = _topk_block_clamp(row_block, m_c, sentinel)
     width = m_c + blk
-    return sentinel * width + width < 2**31
+    return blk, sentinel * width + width < 2**31
 
 
 def _start_host_copy(handle) -> None:
@@ -304,10 +297,31 @@ class SimHashIndex:
     compacting).
     """
 
+    _TOPK_IMPLS = ("auto", "fused", "scan")
+
     def __init__(self, codes, *, mesh=None, data_axis: str = "data",
-                 n_bits: Optional[int] = None):
+                 n_bits: Optional[int] = None, topk_impl: str = "auto"):
+        if topk_impl not in self._TOPK_IMPLS:
+            raise ValueError(
+                f"topk_impl must be one of {self._TOPK_IMPLS}, "
+                f"got {topk_impl!r}"
+            )
         self.mesh = mesh
         self.data_axis = data_axis
+        # 'auto' = the fused Pallas kernel wherever it can serve (the
+        # default device path; interpreter-mode off-TPU), scan for the
+        # mesh path and degraded retries; 'scan' pins the retained
+        # lax.scan reference path; 'fused' insists on the kernel where
+        # plannable (still degrading to scan on VMEM OOM rather than
+        # failing a serving request).  RP_TOPK_IMPL overrides per
+        # process.
+        self.topk_impl = topk_impl
+        # fused-kernel degraded-retry memo: (nq, rows_pad, m_c) keys
+        # that hit a scoped-VMEM OOM once are served by the scan path
+        # for the process lifetime (r6 convention: memoize only after
+        # the degraded retry succeeded — see _chunk_topk)
+        self._fused_degraded: set = set()
+        self._scan_fallback_noted: set = set()
         codes = np.asarray(codes, dtype=np.uint8)
         if codes.ndim != 2:
             raise ValueError(f"codes must be (n, nbytes), got {codes.shape}")
@@ -642,6 +656,8 @@ class SimHashIndex:
 
     # -- serving path: on-device top-k (BL:10, the 1B-code regime) -----------
 
+    # scan-path tuning (the RETAINED reference/mesh path; the fused
+    # kernel sizes its own tiles via ops/topk_kernels.plan_fused):
     _TOPK_ROW_BLOCK = 32768  # code rows scored per scan step (dist tile
     # t×32768 f32 ≈ 256 MB at the default query tile — an HBM working set,
     # amortizing one MXU dot per step).  Measured r5 at a 16.7M-code index:
@@ -666,21 +682,30 @@ class SimHashIndex:
 
         The Hamming kernel is an MXU matmul, not a VPU popcount: codes
         unpack to ±1 bf16 on the fly (exact — f32 accumulation of ±1 sums)
-        and ``hamming = (bits - s_a·s_bᵀ)/2``.  A ``lax.scan`` over
-        ``_TOPK_ROW_BLOCK``-row blocks of the resident chunk carries the
-        running ``(dist, idx)`` top-m, so the full ``(tile, n_codes)``
-        distance matrix never exists anywhere — HBM holds one block's
-        scores, and d2h per query is ``O(p·m)`` (shard candidates), not
-        ``O(n_codes)``.  Host work is merging ``p·m`` candidates per query.
+        and ``hamming = (bits - s_a·s_bᵀ)/2``.  The fused Pallas kernel
+        loops over code blocks INSIDE one dispatch per query tile
+        (double-buffered DMA; the scan path iterates the same blocks via
+        ``lax.scan``), carrying the running ``(dist, idx)`` top-m in
+        VMEM, so the full ``(tile, n_codes)`` distance matrix never
+        exists anywhere — HBM holds one block's scores, and d2h per
+        query is ``O(p·m)`` (shard candidates), not ``O(n_codes)``.
+        Host work is merging ``p·m`` candidates per query.
 
-        Device-path bound: the scanned selection packs ``(dist, position)``
-        into one int32 key, which requires ``(n_bits+2)·(m+blk) < 2**31``
-        at the clamped scan block (``blk ≥ 8``) — so ``m`` up to
-        ``~2^31/(n_bits+2)`` (≈8.3M at 256-bit codes) runs on device.
-        Larger requests (or very wide codes) fall back to the dense
-        ``query()`` + host selection path: same results, same (distance,
-        lower-id) tie order, but d2h is the full ``O(n_codes)`` row —
-        analysis-scale throughput, not serving-scale.
+        Device path (ISSUE 7): the default is the fused Pallas kernel
+        (``ops/topk_kernels.py``) — one dispatch per query tile whose
+        in-kernel loop streams code blocks through double-buffered DMA
+        and merges a running top-m against VMEM-resident ``(dist, idx)``
+        carries.  Because distance and index are separate carries (no
+        packed ``(dist, position)`` int32 key across the carry), the old
+        ``(n_bits+2)·(m+blk) < 2**31`` ceiling is gone: any ``m`` whose
+        carry fits VMEM runs on device.  The ``lax.scan`` path is
+        retained for the mesh case, ``topk_impl='scan'``, and as the
+        VMEM-OOM degraded retry.  Only genuinely host-scale requests —
+        ``m`` beyond every VMEM-feasible carry AND beyond the scan
+        path's packed key, or codes wider than 2^24 bits (past f32-exact
+        Hamming) — fall back to the dense ``query()`` + host selection
+        path: same results, same (distance, lower-id) tie order, but d2h
+        is the full ``O(n_codes)`` row.
         """
         if not isinstance(m, numbers.Integral) or m <= 0:
             raise ValueError(f"m must be a positive int, got {m!r}")
@@ -699,16 +724,10 @@ class SimHashIndex:
         # host select (dense fallback), so they can never win — and the
         # result width never includes sentinel filler
         m_eff = int(min(m, self.n_live))
-        if not all(
-            _topk_key_fits_int32(
-                self.n_bytes * 8,
-                int(min(m_eff, c.n)),
-                min(self._TOPK_ROW_BLOCK, c.b.shape[0]),
-            )
-            for c in self._chunks
-        ):
-            # int32 key packing cannot represent the request on device:
-            # serve it through the dense path rather than raising
+        tile_rows = max(int(min(tile, A.shape[0])), 1)
+        if self._topk_route(tile_rows, m_eff) == "dense":
+            # genuinely host-scale request: no device path (fused OR
+            # scan) can represent it — serve dense rather than raising
             telemetry.registry().counter_inc("simhash.topk_dense_fallbacks")
             telemetry.emit(
                 EVENTS.SIMHASH_TOPK_DENSE_FALLBACK, m=int(m_eff),
@@ -793,22 +812,163 @@ class SimHashIndex:
             finish(pending.pop(0))
         return out_d, out_i
 
+    def _topk_impl_pref(self) -> str:
+        """Constructor preference, overridable per process via the
+        ``RP_TOPK_IMPL`` environment variable (``fused`` / ``scan`` /
+        ``auto``)."""
+        import os
+
+        env = os.environ.get("RP_TOPK_IMPL", "").strip().lower()
+        return env if env in self._TOPK_IMPLS else self.topk_impl
+
+    def _scan_fits(self, rows_pad: int, m_c: int) -> bool:
+        _, fits = _scan_clamp(
+            min(self._TOPK_ROW_BLOCK, rows_pad), m_c, self.n_bytes * 8 + 1
+        )
+        return fits
+
+    def _fused_mode(self, nq: int, rows_pad: int, m_c: int):
+        """``(plan, degraded)`` when the fused kernel serves this chunk
+        shape, else None.  Normally the auto (largest-feasible) plan;
+        once the shape has hit a scoped-VMEM OOM (memoized in
+        ``_fused_degraded``) the scan path takes over when it can
+        represent the request, and the MINIMAL-VMEM fused tiling serves
+        otherwise (over-the-old-ceiling shapes have no scan
+        representation to degrade to).  Computed ONCE per dispatch and
+        passed through to ``fused_topk`` — the routing and the kernel
+        can never disagree on the tiling."""
+        from randomprojection_tpu.ops import topk_kernels
+
+        degraded = (nq, rows_pad, m_c) in self._fused_degraded
+        if degraded and self._scan_fits(rows_pad, m_c):
+            return None
+        plan = topk_kernels.plan_fused(
+            nq, rows_pad, self.n_bytes, m_c, minimal=degraded
+        )
+        return None if plan is None else (plan, degraded)
+
+    def _note_scan_fallback(self, nq: int, rows_pad: int, m_c: int):
+        """The default route wanted the kernel but the scan path is
+        serving (unplannable tiling or a memoized VMEM-OOM): a
+        degradation worth a line on the telemetry spine, once per
+        shape."""
+        key = (nq, rows_pad, m_c)
+        if key not in self._scan_fallback_noted:
+            self._scan_fallback_noted.add(key)
+            telemetry.registry().counter_inc("simhash.topk_scan_fallbacks")
+            telemetry.emit(
+                EVENTS.TOPK_KERNEL_SCAN_FALLBACK, queries=int(nq),
+                m=int(m_c), rows=int(rows_pad),
+            )
+
+    def _chunk_impl(self, nq: int, rows_pad: int, m_c: int) -> str:
+        """Which device path serves one chunk at one query-tile shape:
+        ``'fused'`` (the default Pallas kernel), ``'scan'`` (mesh,
+        explicit preference, degraded retry, or an unplannable fused
+        shape), or ``'dense'`` when neither device path can represent
+        the request (genuinely host-scale ``m`` / pathological code
+        width)."""
+        pref = self._topk_impl_pref()
+        wants_fused = self.mesh is None and pref != "scan"
+        if wants_fused and self._fused_mode(nq, rows_pad, m_c) is not None:
+            return "fused"
+        if not self._scan_fits(rows_pad, m_c):
+            return "dense"
+        if wants_fused:
+            self._note_scan_fallback(nq, rows_pad, m_c)
+        return "scan"
+
+    def _topk_route(self, tile_rows: int, m_eff: int) -> str:
+        """``'device'`` when every chunk has a device path for this
+        request shape, else ``'dense'`` (the host-scale fallback)."""
+        for c in self._chunks:
+            if self._chunk_impl(
+                tile_rows, c.b.shape[0], int(min(m_eff, c.n))
+            ) == "dense":
+                return "dense"
+        return "device"
+
     def _chunk_topk(self, a, chunk, m_c: int):
         """Device top-``m_c`` of one chunk for one query tile.  Returns
         ``(dist, local_idx)`` of shape ``(t, m_c)`` (mesh: ``(t, p·m_c)``
         — per-shard candidates, ids already chunk-global).  Pad rows —
         and, when the chunk carries tombstones, deleted rows — are
         masked to an impossible distance before selection; a chunk with
-        no deletions runs the exact pre-tombstone kernel."""
+        no deletions runs the exact pre-tombstone kernel variant.
+
+        Default path: the fused Pallas kernel.  A scoped-VMEM OOM at an
+        untested shape retries once through the retained scan path
+        (``is_vmem_oom`` + ``record_vmem_oom_retry``, the r6 convention)
+        and memoizes the key so the shape stays on the scan path for the
+        process lifetime."""
         import jax.numpy as jnp
 
         dead = self._chunk_dead_device(chunk)
+        nq, rows_pad = a.shape[0], chunk.b.shape[0]
+        mode = None
+        if self.mesh is None and self._topk_impl_pref() != "scan":
+            mode = self._fused_mode(nq, rows_pad, m_c)
+            if mode is None:
+                self._note_scan_fallback(nq, rows_pad, m_c)
+        if mode is not None:
+            from randomprojection_tpu.ops.pallas_kernels import (
+                is_vmem_oom,
+                record_vmem_oom_retry,
+            )
+
+            plan, degraded = mode
+            try:
+                return self._dispatch_fused(a, chunk, m_c, dead, plan)
+            except Exception as e:
+                if not is_vmem_oom(e) or degraded:
+                    # unclassified failures surface; a second OOM at the
+                    # MINIMAL tiling means nothing smaller exists on
+                    # device for this shape — also surface it (the next
+                    # call routes dense via _chunk_impl when scan can't
+                    # represent the request either)
+                    raise
+                # degraded retry (r6 convention): memoize only now —
+                # after the failure is classified — so a misclassified
+                # error cannot pin the shape to the slow path
+                record_vmem_oom_retry(a.shape, "topk_fused", m_c)
+                telemetry.emit(
+                    EVENTS.TOPK_KERNEL_VMEM_RETRY, queries=int(nq),
+                    m=int(m_c), rows=int(rows_pad),
+                    **telemetry.trace_fields(),
+                )
+                self._fused_degraded.add((nq, rows_pad, m_c))
+                retry = self._fused_mode(nq, rows_pad, m_c)
+                if retry is not None:
+                    # scan cannot represent this request (the shapes
+                    # the old int32-key ceiling rejected): degrade
+                    # WITHIN the kernel to the minimal-VMEM tiling
+                    return self._dispatch_fused(
+                        a, chunk, m_c, dead, retry[0]
+                    )
+                # else the scan path serves this dispatch (and this
+                # shape, for the process lifetime)
         fn = self._get_topk_fn(
-            a.shape, chunk.b.shape[0], m_c, masked=dead is not None
+            a.shape, rows_pad, m_c, masked=dead is not None
         )
         if dead is not None:
             return fn(a, chunk.b, jnp.int32(chunk.n), dead)
         return fn(a, chunk.b, jnp.int32(chunk.n))
+
+    def _dispatch_fused(self, a, chunk, m_c: int, dead, plan):
+        from randomprojection_tpu.ops import topk_kernels
+
+        d, i = topk_kernels.fused_topk(
+            a, chunk.b, chunk.n, m_c, dead=dead, plan=plan
+        )
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.TOPK_KERNEL_DISPATCH,
+                queries=int(a.shape[0]), m=int(m_c),
+                rows=int(chunk.b.shape[0]),
+                masked=dead is not None,
+                **telemetry.trace_fields(),
+            )
+        return d, i
 
     def _get_topk_fn(self, a_shape, rows_pad: int, m_c: int, *,
                      masked: bool = False):
@@ -844,7 +1004,7 @@ class SimHashIndex:
         # key fits int32 for any practical (bits, block) pair.
         sentinel = n_bits_total + 1
         blk_requested = blk
-        blk = _topk_block_clamp(blk, m_c, sentinel)
+        blk, fits = _scan_clamp(blk, m_c, sentinel)
         if blk != blk_requested:
             # wide codes / big m shrank the scan block to keep the packed
             # int32 key representable: same results, more scan steps —
@@ -855,12 +1015,12 @@ class SimHashIndex:
                 clamped=int(blk), m=int(m_c), n_bits=n_bits_total,
             )
         width = m_c + blk  # packing base W
-        # same predicate as the dense-fallback gate (idempotent under the
-        # clamp), so the two sites cannot drift
-        if not _topk_key_fits_int32(n_bits_total, m_c, blk):  # pragma: no cover
+        # the routing (_chunk_impl) never sends an unrepresentable
+        # request here — this guards direct callers of the scan builder
+        if not fits:  # pragma: no cover
             raise ValueError(
-                f"top-k key would overflow int32: bits={n_bits_total}, "
-                f"block={blk}"
+                f"scan-path top-k key would overflow int32: "
+                f"bits={n_bits_total}, m={m_c}, block={blk}"
             )
 
         def local_topk(a, b, n_real, dead=None):

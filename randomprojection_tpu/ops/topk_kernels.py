@@ -1,0 +1,508 @@
+"""Fused Pallas TPU kernel for SimHash Hamming top-k serving (ISSUE 7).
+
+The r5 serving number — 1,687 q/s at a 16.7M-code index, 7.4% MXU
+(``BENCH_r05.json`` config4) — was bounded by ``lax.scan`` loop overhead:
+~2-3 ms per scan iteration on that box regardless of body size, dwarfing
+the sub-ms dot+select body (the ``_TOPK_UNROLL``/32k-row-block tuning in
+``models/sketch.py`` only amortized it).  This module replaces the scan
+with ONE kernel dispatch per query tile: a Pallas grid over query tiles
+whose body loops over the resident code blocks **inside the kernel** —
+zero per-block dispatch cost — with the next block's HBM→VMEM transfer
+manually double-buffered (``pltpu.make_async_copy``, the revolving
+two-slot pattern) so the MXU never waits on HBM.
+
+Per (query tile, code block) step the kernel fuses:
+
+1. **DMA**: wait for block ``t``'s copy, start block ``t+1``'s into the
+   other buffer slot.  Blocks are tiled over rows AND bytes, so code
+   widths far beyond VMEM (the contraction dimension) stream through the
+   same two slots.
+2. **Hamming matmul**: packed uint8 codes unpack to ±1 bf16 in VMEM and
+   contract against the ±1 query tile on the MXU with f32 accumulation —
+   exact for any ``n_bits ≤ 2^24`` (``hamming = (bits - s_a·s_bᵀ)/2``;
+   zero pad bits match on both sides and cancel).
+3. **Tombstone / pad masking**: deleted and padded rows take the
+   sentinel distance *before* selection, so they can never displace a
+   live code from the running top-m.
+4. **Running top-m merge** against VMEM-resident carries.  The carries
+   are SEPARATE ``(dist, idx)`` int32 planes — the selection key never
+   packs ``(dist, position)`` over the carry width, which is what
+   imposed the old ``(n_bits+2)·(m+blk) < 2^31`` ceiling on the scan
+   path (``m ≲ 8.3M`` at 256-bit codes).  Packing survives only
+   *within* one block (``key = dist·B + pos``, ``B = pow2(blk)`` — the
+   block auto-shrinks for wide codes, a perf knob, not a capability
+   bound), where position order IS ascending-id order, so the values-
+   only bitonic select is tie-correct by construction.  The merge step
+   is the classic bitonic top-k update: ``low[i] = min(carry[i],
+   block_top[M-1-i])`` under the (dist, id) lexicographic order yields
+   exactly the M smallest as a bitonic sequence, sorted by one
+   ``log2(M)``-stage merge network.
+
+Contract (bit-for-bit with the retained scan path and
+``topk_bruteforce``): ascending Hamming distance, exact ties broken by
+the LOWER global id, identical across chunk layouts, block sizes and
+query tiling.  Ids returned are chunk-local; empty slots carry
+``(sentinel, 2^31-1)`` exactly like the scan path's init, so the host
+cross-chunk merge is unchanged.
+
+Interpreter mode (``interpret=True``, auto-selected off-TPU) runs the
+identical kernel — DMAs, double buffering, masking, merge — under the
+Pallas interpreter so tier-1 exercises the whole path on CPU.  Mosaic
+lowering of the lane-axis rolls/reshapes in the sort networks is
+untested on a real chip this round (no TPU on this box — see
+BASELINE.md r12 note); the structure follows the guide's supported
+patterns.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["TopkPlan", "plan_fused", "fused_topk", "interpret_default"]
+
+# Mosaic's scoped-VMEM limit and the measured temporary headroom — same
+# constants as ops/pallas_kernels.py (kept local: the two kernels budget
+# independent buffer sets and must not couple their tuning).
+_VMEM_LIMIT = 16 << 20
+_VMEM_HEADROOM = 3 << 20
+
+# f32-exact distance bound: the ±1 dot accumulates integers in f32, exact
+# only up to 2^24 — codes wider than 2^24 bits cannot be served by the
+# MXU Hamming path at all (scan shares the same arithmetic; the dense
+# host path serves them).
+_MAX_BITS_EXACT = 1 << 24
+
+_INT32_MAX = (1 << 31) - 1
+
+
+class TopkPlan(NamedTuple):
+    """A VMEM-feasible tiling for one fused top-k shape.
+
+    ``tq`` query rows per grid step, ``blk`` code rows per DMA block,
+    ``cb`` code BYTES per DMA tile (``cb == n_bytes`` for narrow codes;
+    wide codes stream the contraction dimension through the same two
+    buffer slots), ``q_packed`` whether the query tile enters the kernel
+    packed (unpacked per byte-tile in VMEM — only for codes too wide to
+    keep the ±1 query plane resident), ``m_pad`` the pow2-padded carry
+    width."""
+
+    tq: int
+    blk: int
+    cb: int
+    q_packed: bool
+    m_pad: int
+
+
+def _ceil_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length()
+
+
+def interpret_default() -> bool:
+    """Off-TPU platforms (this box's CPU tier-1, GPUs) run the kernel
+    under the Pallas interpreter — same deny-list as the lazy-projection
+    guard in ``backends/jax_backend.py`` (unknown platforms like the
+    virtualized ``axon`` chip are TPU-backed)."""
+    return jax.default_backend() in ("cpu", "gpu", "cuda", "rocm")
+
+
+def plan_fused(nq: int, rows: int, n_bytes: int, m: int, *,
+               minimal: bool = False) -> Optional[TopkPlan]:
+    """The largest VMEM-feasible ``(tq, blk, cb)`` tiling for a fused
+    top-``m`` over ``(nq queries) × (rows codes of n_bytes)``, or None
+    when no tiling fits — the caller then falls back (scan path, or the
+    dense host path for genuinely host-scale ``m``).  ``minimal=True``
+    returns the SMALLEST feasible tiling instead: the degraded retry
+    after a scoped-VMEM OOM on a shape the scan path cannot represent
+    (same search space, so a shape with an auto plan always has a
+    minimal one).
+
+    Feasibility, in order of preference (large ``tq`` first — fewer
+    kernel launches and query re-fetches — then large ``blk``):
+
+    - packed-key bound: ``(sentinel+1)·pow2(blk) ≤ 2^31`` (the only
+      place distance still packs with position, strictly within one
+      block — wide codes shrink ``blk`` instead of capping ``m``);
+    - byte tile: ``cb`` divides ``n_bytes`` (whole codes when they fit,
+      else a pow2 divisor) and the unpacked ±1 block tile fits VMEM;
+    - the budget: query plane + two DMA slots + unpacked tile + the
+      (tq, blk) distance/accumulator/key planes + (tq, m_pad) carries +
+      sort-network temporaries + Mosaic headroom ≤ the 16 MiB scoped
+      limit.
+    """
+    if nq <= 0 or rows <= 0 or m <= 0:
+        return None
+    n_bits = n_bytes * 8
+    sentinel = n_bits + 1
+    if n_bits > _MAX_BITS_EXACT:
+        return None  # distances not f32-exact: host path territory
+    m_pad = max(8, _ceil_pow2(m))
+    # carries alone must leave room for everything else even at tq=1
+    if 2 * m_pad * 4 > _VMEM_LIMIT // 4:
+        return None  # genuinely host-scale m
+    b_cap = (1 << 31) // (sentinel + 1)  # pow2(blk) bound for the block key
+    if b_cap < 8:
+        return None  # pathologically wide codes (≥ ~2^27 bits/row)
+    tq_cands = [t for t in (2048, 1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+                if t <= max(_ceil_pow2(nq), 1)]
+    blk_cands = [b for b in (4096, 2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+                 if b <= min(b_cap, max(_ceil_pow2(rows), 8))]
+    if minimal:
+        tq_cands = list(reversed(tq_cands))
+        blk_cands = list(reversed(blk_cands))
+    for tq in tq_cands:
+        # resident ±1 query plane when it fits a quarter of VMEM;
+        # otherwise the packed tile stays resident and each byte tile
+        # unpacks its query slice on the fly
+        q_unpacked_bytes = tq * n_bits * 2
+        q_packed = q_unpacked_bytes > _VMEM_LIMIT // 4
+        q_bytes = tq * n_bytes if q_packed else q_unpacked_bytes
+        for blk in blk_cands:
+            # byte tile: whole codes, else the largest pow2 divisor that
+            # keeps the unpacked ±1 tile ≤ 4 MiB
+            cb = n_bytes
+            if blk * cb * 16 > (4 << 20):
+                cb = 1
+                while (
+                    cb * 2 <= n_bytes
+                    and n_bytes % (cb * 2) == 0
+                    and blk * cb * 2 * 16 <= (4 << 20)
+                ):
+                    cb *= 2
+                if n_bytes % cb or blk * cb * 16 > (4 << 20):
+                    continue
+            usage = (
+                q_bytes
+                + 2 * blk * cb                      # DMA double buffer
+                + blk * cb * 8 * 2                  # unpacked ±1 tile
+                + (tq * cb * 8 * 2 if q_packed else 0)
+                + 3 * tq * blk * 4                  # acc, dist, keys
+                + 2 * tq * m_pad * 4                # (dist, idx) carries
+                + 6 * tq * m_pad * 4                # merge temporaries
+                + _VMEM_HEADROOM
+            )
+            if usage <= _VMEM_LIMIT:
+                return TopkPlan(tq, blk, cb, q_packed, m_pad)
+    return None
+
+
+def _unpack_pm1(codes_u8):
+    """Packed uint8 → ±1 bf16 bits, little-endian within each byte
+    (matches ``np.packbits(bitorder='little')`` and the scan path)."""
+    b = codes_u8.astype(jnp.int32)
+    bits = (b[:, :, None] >> jnp.arange(8, dtype=jnp.int32)) & 1
+    bits = bits.reshape(codes_u8.shape[0], -1)
+    return (2 * bits - 1).astype(jnp.bfloat16)
+
+
+def _lane_iota(L: int):
+    return jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
+
+
+def _xor_partner(x, s: int):
+    """``p[i] = x[i ^ s]`` along the lane axis for pow2 stride ``s`` —
+    two cyclic rolls and a select (the XOR partner of a bitonic stage
+    never wraps: bit ``s`` of ``i`` decides the roll direction)."""
+    low = (_lane_iota(x.shape[-1]) & s) == 0
+    return jnp.where(low, jnp.roll(x, -s, axis=-1), jnp.roll(x, s, axis=-1))
+
+
+def _sort_stage(key, s: int, k: int):
+    """One bitonic compare-exchange stage on int32 VALUES: partner at
+    XOR distance ``s``, ascending runs where ``(iota & k) == 0``."""
+    L = key.shape[-1]
+    iota = _lane_iota(L)
+    low = (iota & s) == 0
+    p = _xor_partner(key, s)
+    take_min = low == ((iota & k) == 0)
+    return jnp.where(take_min, jnp.minimum(key, p), jnp.maximum(key, p))
+
+
+def _merge_stage_pairs(d, i, s: int):
+    """One ascending bitonic-merge stage on (dist, id) PAIRS under the
+    lexicographic (dist, lower-id-wins) order — the total order the
+    ``query_topk`` contract documents."""
+    iota = _lane_iota(d.shape[-1])
+    low = (iota & s) == 0
+    pd, pi = _xor_partner(d, s), _xor_partner(i, s)
+    p_lt = (pd < d) | ((pd == d) & (pi < i))
+    sel_p = jnp.where(low, p_lt, ~p_lt)
+    return jnp.where(sel_p, pd, d), jnp.where(sel_p, pi, i)
+
+
+def _block_top(key, m_s: int):
+    """Ascending top-``m_s`` VALUES of each row of ``key`` (t, B):
+    bitonic-sort ``m_s``-segments, then merge-truncate rounds (keep the
+    min half of each adjacent pair of sorted runs) until one run of
+    ``m_s`` remains.  ``m_s`` and ``B`` are pow2, ``m_s ≤ B``."""
+    t, B = key.shape
+    k = 2
+    while k <= m_s:
+        s = k // 2
+        while s >= 1:
+            # direction from the index bit at merge size k — except at
+            # the final k == m_s group, where EVERY segment must finish
+            # ascending (the global bit m_s alternates per segment; the
+            # all-ascending form has k ≥ width, making (iota & k) == 0)
+            key = _sort_stage(key, s, 2 * B if k == m_s else k)
+            s //= 2
+        k *= 2
+    W = B
+    while W > m_s:
+        a = key.reshape(t, W // (2 * m_s), 2, m_s)
+        lo, hi = a[:, :, 0, :], jnp.flip(a[:, :, 1, :], axis=-1)
+        key = jnp.minimum(lo, hi).reshape(t, W // 2)  # bitonic runs
+        s = m_s // 2
+        while s >= 1:
+            key = _sort_stage(key, s, 2 * key.shape[-1])  # all-ascending
+            s //= 2
+        W //= 2
+    return key
+
+
+def _merge_carry(cd, ci, bd, bi, m_pad: int):
+    """Exact running top-m update: carry (sorted asc) vs block
+    candidates (sorted asc, sentinel-padded to ``m_pad``).
+    ``low[i] = min(carry[i], block[M-1-i])`` under (dist, id) lex order
+    is exactly the M smallest of the union, as a bitonic sequence; one
+    merge network sorts it."""
+    fd, fi = jnp.flip(bd, axis=-1), jnp.flip(bi, axis=-1)
+    take_b = (fd < cd) | ((fd == cd) & (fi < ci))
+    nd = jnp.where(take_b, fd, cd)
+    ni = jnp.where(take_b, fi, ci)
+    s = m_pad // 2
+    while s >= 1:
+        nd, ni = _merge_stage_pairs(nd, ni, s)
+        s //= 2
+    return nd, ni
+
+
+def _topk_kernel(meta_ref, q_ref, codes_hbm, *rest, plan: TopkPlan,
+                 rows_pad: int, n_bytes: int, masked: bool):
+    """Kernel body for one query tile: in-kernel double-buffered DMA
+    over (row block × byte tile) code tiles, fused Hamming matmul +
+    masking + running top-m merge.  See the module docstring for the
+    full argument/carry layout."""
+    if masked:
+        dead_hbm, od_ref, oi_ref, buf, sem, dead_buf, dead_sem = rest
+    else:
+        od_ref, oi_ref, buf, sem = rest
+        dead_hbm = dead_buf = dead_sem = None
+    tq, blk, cb, q_packed, m_pad = plan
+    n_bits = n_bytes * 8
+    sentinel = jnp.int32(n_bits + 1)
+    nchunk = n_bytes // cb
+    nblk = -(-rows_pad // blk)  # ragged tail: clamped-offset re-read
+    B = _ceil_pow2(blk)
+    m_s = min(m_pad, B)
+    n_real = meta_ref[0]
+    total = nblk * nchunk
+
+    def tile_copy(t):
+        bi = t // nchunk
+        cj = t % nchunk
+        row_off = jnp.minimum(bi * blk, rows_pad - blk)
+        return pltpu.make_async_copy(
+            codes_hbm.at[pl.ds(row_off, blk), pl.ds(cj * cb, cb)],
+            buf.at[t % 2],
+            sem.at[t % 2],
+        )
+
+    tile_copy(0).start()  # warm the pipeline
+
+    pos_iota = jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)[0]
+
+    def block_step(bi, carry):
+        cd, ci = carry
+        row_off = jnp.minimum(bi * blk, rows_pad - blk)
+        if masked:
+            # started HERE so the tiny mask transfer rides under the
+            # whole block's matmul loop instead of stalling selection
+            # (single slot: the previous block's wait precedes this
+            # start in program order)
+            dcp = pltpu.make_async_copy(
+                dead_hbm.at[pl.ds(row_off, blk)], dead_buf, dead_sem
+            )
+            dcp.start()
+
+        def chunk_step(cj, acc):
+            t = bi * nchunk + cj
+
+            @pl.when(t + 1 < total)
+            def _():
+                tile_copy(t + 1).start()
+
+            tile_copy(t).wait()
+            s_b = _unpack_pm1(buf[t % 2])
+            if q_packed:
+                q = _unpack_pm1(q_ref[:, pl.ds(cj * cb, cb)])
+            else:
+                q = q_ref[:, pl.ds(cj * cb * 8, cb * 8)]
+            return acc + jax.lax.dot_general(
+                q, s_b,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        acc = jax.lax.fori_loop(
+            0, nchunk, chunk_step, jnp.zeros((tq, blk), jnp.float32)
+        )
+        d = ((jnp.float32(n_bits) - acc) * 0.5).astype(jnp.int32)
+        ids = row_off + pos_iota
+        # two mask layers: the clamped last block re-reads rows earlier
+        # blocks already scored (keep only ids >= bi*blk — never a
+        # duplicate candidate), and trailing pad rows never existed
+        keep = (ids >= bi * blk) & (ids < n_real)
+        if masked:
+            dcp.wait()
+            keep = keep & (dead_buf[:, 0] == 0)
+        d = jnp.where(keep[None, :], d, sentinel)
+        # values-only select within the block: key = dist·B + pos packs
+        # int32 by the plan bound; pos order IS ascending-id order, so
+        # ascending key is the (dist, lower-id) total order
+        key = d * jnp.int32(B) + pos_iota[None, :]
+        if B > blk:
+            key = jnp.pad(
+                key, ((0, 0), (0, B - blk)),
+                constant_values=sentinel * B + blk,
+            )
+        top = _block_top(key, m_s)
+        bd = top >> B.bit_length() - 1
+        bp = top & jnp.int32(B - 1)
+        bi_ids = jnp.where(bd >= sentinel, jnp.int32(_INT32_MAX),
+                           row_off + bp)
+        bd = jnp.minimum(bd, sentinel)
+        if m_s < m_pad:
+            bd = jnp.pad(bd, ((0, 0), (0, m_pad - m_s)),
+                         constant_values=int(n_bits + 1))
+            bi_ids = jnp.pad(bi_ids, ((0, 0), (0, m_pad - m_s)),
+                             constant_values=_INT32_MAX)
+        return _merge_carry(cd, ci, bd, bi_ids, m_pad)
+
+    init = (
+        jnp.full((tq, m_pad), sentinel, jnp.int32),
+        jnp.full((tq, m_pad), jnp.int32(_INT32_MAX)),
+    )
+    cd, ci = jax.lax.fori_loop(0, nblk, block_step, init)
+    od_ref[:] = cd
+    oi_ref[:] = ci
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("plan", "n_bytes", "m", "interpret", "masked"),
+)
+def _fused_impl(q, codes, n_real, dead, *, plan: TopkPlan, n_bytes: int,
+                m: int, interpret: bool, masked: bool):
+    tq, blk, cb, q_packed, m_pad = plan
+    nq = q.shape[0]
+    rows = codes.shape[0]
+    # tiny indexes pad up to one block; big ones stream ragged last
+    # blocks via the clamped-offset re-read (no per-call full-index pad)
+    if rows < blk:
+        codes = jnp.pad(codes, ((0, blk - rows), (0, 0)))
+        if masked:
+            dead = jnp.pad(dead, ((0, blk - rows), (0, 0)))
+    # ragged tails stay ragged — the kernel clamps the last block's
+    # offset and re-reads (id-masked) instead of padding the resident
+    # index per call
+    rows_pad = codes.shape[0]
+    nq_pad = -(-nq // tq) * tq
+    if nq_pad != nq:
+        q = jnp.pad(q, ((0, nq_pad - nq), (0, 0)))
+    if q_packed:
+        q_in = q
+        q_width = n_bytes
+    else:
+        q_in = _unpack_pm1(q)
+        q_width = n_bytes * 8
+    meta = jnp.asarray([n_real], dtype=jnp.int32)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((tq, q_width), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    operands = [meta, q_in, codes]
+    scratch = [
+        pltpu.VMEM((2, blk, cb), jnp.uint8),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    if masked:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        operands.append(dead)
+        scratch += [
+            pltpu.VMEM((blk, 1), jnp.uint8),
+            pltpu.SemaphoreType.DMA(()),
+        ]
+    od, oi = pl.pallas_call(
+        functools.partial(
+            _topk_kernel, plan=plan, rows_pad=rows_pad, n_bytes=n_bytes,
+            masked=masked,
+        ),
+        grid=(nq_pad // tq,),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((tq, m_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tq, m_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((nq_pad, m_pad), jnp.int32),
+            jax.ShapeDtypeStruct((nq_pad, m_pad), jnp.int32),
+        ),
+        scratch_shapes=scratch,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * nq_pad * n_bytes * 8 * rows_pad,
+            bytes_accessed=(
+                (nq_pad // tq) * rows_pad * n_bytes + nq_pad * q_width
+            ),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(*operands)
+    return od[:nq, :m], oi[:nq, :m]
+
+
+def fused_topk(q, codes, n_real, m: int, *, dead=None,
+               plan: Optional[TopkPlan] = None,
+               interpret: Optional[bool] = None):
+    """Exact fused top-``m`` of one code chunk for one query tile.
+
+    ``q`` (nq, n_bytes) uint8 packed query codes, ``codes`` (rows,
+    n_bytes) uint8 resident chunk (pad rows beyond ``n_real`` are
+    ignored), ``dead`` optional (rows,) uint8 tombstone mask (1 =
+    deleted, filtered in-selection).  Returns ``(dist, idx)`` each
+    ``(nq, m)`` int32 — ascending distance, ties to the LOWER chunk-
+    local id, empty slots ``(n_bits+1, 2^31-1)`` — bit-identical to the
+    scan path and ``topk_bruteforce``.
+
+    ``plan=None`` resolves the VMEM tiling via ``plan_fused`` (raises
+    ``ValueError`` when no tiling fits — callers route those requests
+    to the scan or dense paths *before* dispatch); ``interpret=None``
+    auto-selects the Pallas interpreter off-TPU."""
+    if interpret is None:
+        interpret = interpret_default()
+    n_bytes = int(codes.shape[1])
+    if plan is None:
+        plan = plan_fused(int(q.shape[0]), int(codes.shape[0]), n_bytes, m)
+        if plan is None:
+            raise ValueError(
+                f"no VMEM-feasible fused top-k tiling for nq={q.shape[0]}, "
+                f"rows={codes.shape[0]}, n_bytes={n_bytes}, m={m}"
+            )
+    masked = dead is not None
+    if masked:
+        dead = jnp.asarray(dead, jnp.uint8).reshape(-1, 1)
+    else:
+        dead = jnp.zeros((0, 1), jnp.uint8)  # static placeholder
+    return _fused_impl(
+        q, codes, jnp.int32(n_real), dead, plan=plan, n_bytes=n_bytes,
+        m=int(m), interpret=bool(interpret), masked=masked,
+    )

@@ -157,6 +157,11 @@ class EVENTS:
     SIMHASH_TOPK_TILE = "simhash.topk_tile"
     SIMHASH_TOPK_BLOCK_CLAMP = "simhash.topk_block_clamp"
     SIMHASH_TOPK_DENSE_FALLBACK = "simhash.topk_dense_fallback"
+    # fused serving kernel (ISSUE 7): per-tile kernel dispatches, the
+    # VMEM-OOM degraded retry, and fused->scan routing fallbacks
+    TOPK_KERNEL_DISPATCH = "topk.kernel.dispatch"
+    TOPK_KERNEL_VMEM_RETRY = "topk.kernel.vmem_retry"
+    TOPK_KERNEL_SCAN_FALLBACK = "topk.kernel.scan_fallback"
     SERVE_TOPK_BATCH = "serve.topk_batch"
     SERVE_TOPK_ERROR = "serve.topk.error"
     # durable index lifecycle (snapshot/restore + crash recovery)

@@ -55,6 +55,8 @@ DEGRADED_EVENTS = (
     EVENTS.BACKEND_VMEM_OOM_RETRY,
     EVENTS.SIMHASH_TOPK_DENSE_FALLBACK,
     EVENTS.SIMHASH_TOPK_BLOCK_CLAMP,
+    EVENTS.TOPK_KERNEL_VMEM_RETRY,
+    EVENTS.TOPK_KERNEL_SCAN_FALLBACK,
     EVENTS.STREAM_PREFETCH_ERROR,
     EVENTS.STREAM_PREFETCH_SHUTDOWN_TIMEOUT,
     EVENTS.STREAM_STAGED_ERROR,
@@ -139,6 +141,8 @@ def build_report(path: str) -> dict:
     child_wall = 0.0
     recover_resumes: list = []
     orphan_chunks = 0
+    topk_dispatches = 0
+    topk_queries = 0
 
     for e in read_events(path):
         n_events += 1
@@ -209,6 +213,12 @@ def build_report(path: str) -> dict:
             })
         elif name == EVENTS.RECOVER_ORPHAN_CHUNK:
             orphan_chunks += 1
+        elif name == EVENTS.TOPK_KERNEL_DISPATCH:
+            # fused serving-kernel dispatches (one per query tile per
+            # chunk): the doctor's view of how much top-k traffic the
+            # kernel path actually served
+            topk_dispatches += 1
+            topk_queries += e.get("queries", 0) or 0
 
     # traces whose root never ended: their buffered children are orphaned
     # work of a crashed run — count the traces as incomplete
@@ -276,6 +286,14 @@ def build_report(path: str) -> dict:
             "overlap_ratio_est": round(overlap, 3),
         },
         "queue_depth": queue,
+        "serving": (
+            {
+                "topk_kernel_dispatches": topk_dispatches,
+                "topk_kernel_queries": topk_queries,
+            }
+            if topk_dispatches
+            else None
+        ),
         "degraded": degraded,
         "unregistered_events": unregistered,
         "recovery": (
@@ -346,6 +364,12 @@ def render_report(report: dict) -> str:
             f"prefetch queue: {q['samples']} samples, depth max {q['max']}"
             f"/mean {q['mean']}"
             + (f" (capacity {q['capacity']})" if q.get("capacity") else "")
+        )
+    sv = report.get("serving")
+    if sv:
+        lines.append(
+            f"serving: {sv['topk_kernel_dispatches']} fused top-k kernel "
+            f"dispatch(es), {sv['topk_kernel_queries']} query rows"
         )
     lines.append("")
     lines.append("degraded-event audit:")

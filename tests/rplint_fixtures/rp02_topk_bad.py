@@ -1,0 +1,15 @@
+"""RP02 fixture (ISSUE 7 satellite): a serving-kernel path emitting a
+``topk.kernel.*`` event name that is NOT in ``telemetry.EVENTS``.
+Linted against the REAL registry — the topk.kernel namespace
+deliberately has NO family prefix, so every kernel event must be
+individually registered (a family would wave rogue names through)."""
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+
+def dispatch_with_unregistered_event(queries, m):
+    # VIOLATION: a kernel event dodging the registry — invisible to the
+    # doctor's serving section and the degraded audit
+    telemetry.emit("topk.kernel.rogue_dispatch", queries=queries, m=m)
+    # ok: the registered dispatch event
+    telemetry.emit(EVENTS.TOPK_KERNEL_DISPATCH, queries=queries, m=m)

@@ -120,7 +120,9 @@ def test_dense_fallback_filters_tombstones(monkeypatch):
     idx.delete(dead)
     ref_d, ref_i = _filtered_reference(q, codes, dead, 6)
     # force the dense query()+host-selection path
-    monkeypatch.setattr(sk, "_topk_key_fits_int32", lambda *a: False)
+    monkeypatch.setattr(
+        sk.SimHashIndex, "_topk_route", lambda self, t, m: "dense"
+    )
     d, i = idx.query_topk(q, 6)
     np.testing.assert_array_equal(d, ref_d)
     np.testing.assert_array_equal(i, ref_i)

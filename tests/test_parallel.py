@@ -500,14 +500,21 @@ def test_countsketch_mesh_input_arrives_row_sharded(devices):
     np.testing.assert_allclose(Y, Y1, rtol=1e-5, atol=1e-6)
 
 
-def test_topk_block_clamp_keeps_key_in_int32():
-    """Wide codes must shrink the scan block (not error): the packed
-    selection key dist*(m+blk)+pos has to fit int32 for any code width."""
-    from randomprojection_tpu.models.sketch import _topk_block_clamp
+def test_scan_clamp_keeps_key_in_int32():
+    """Wide codes must shrink the RETAINED scan path's block (not
+    error): its packed selection key dist*(m+blk)+pos has to fit int32
+    for any code width.  (The fused kernel has no such bound — its
+    carries are separate (dist, idx) planes.)"""
+    from randomprojection_tpu.models.sketch import _scan_clamp
 
     # 256-bit codes: the default block passes untouched
-    assert _topk_block_clamp(32768, 16, 257) == 32768
+    blk, fits = _scan_clamp(32768, 16, 257)
+    assert blk == 32768 and fits
     # 131072-bit codes (16 KiB/code): halves until the key fits
-    blk = _topk_block_clamp(32768, 16, 131073)
-    assert blk == 8192
+    blk, fits = _scan_clamp(32768, 16, 131073)
+    assert blk == 8192 and fits
     assert (131073 + 1) * (16 + blk) < 2**31
+    # a request past even the floor block reports unfit (the routing
+    # then tries fused, then dense) instead of overflowing silently
+    _, fits = _scan_clamp(32768, 130000, 2**24 + 1)
+    assert not fits

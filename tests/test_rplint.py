@@ -136,6 +136,29 @@ def test_rp02_unregistered_recovery_event_fixture():
     assert not suppressed
 
 
+def test_rp02_unregistered_topk_kernel_event_fixture():
+    """ISSUE 7 satellite: an unregistered ``topk.kernel.*`` emit is
+    caught against the REAL shipped registry — the serving-kernel
+    namespace has no family prefix, so each event must be individually
+    registered, and the registered dispatch event in the same fixture
+    stays clean."""
+    real = rplint.load_event_registry(
+        open(os.path.join(
+            rplint.package_root(), "utils", "telemetry.py"
+        )).read()
+    )
+    assert real is not None and real.knows("topk.kernel.dispatch")
+    assert real.knows("topk.kernel.vmem_retry")
+    assert real.knows("topk.kernel.scan_fallback")
+    assert not real.knows("topk.kernel.rogue_dispatch")
+    active, suppressed = _split(
+        _lint_fixture("rp02_topk_bad.py", registry=real)
+    )
+    assert [f.rule for f in active] == ["RP02"]
+    assert "'topk.kernel.rogue_dispatch'" in active[0].message
+    assert not suppressed
+
+
 def test_rp04_zero_and_negative_maxsize_are_unbounded():
     """Python treats any maxsize <= 0 as unbounded — every spelling of
     that must trip RP04, not just the bare constructor."""

@@ -394,9 +394,9 @@ def test_simhash_index_int32_id_guard():
     assert idx.n_codes == 2**31 - 10, "a refused add must not mutate state"
 
 
-def test_query_topk_dense_fallback_when_key_overflows(monkeypatch):
-    """ADVICE r5: when the int32 key packing cannot represent a request
-    (huge m / very wide codes), query_topk must serve it through the dense
+def test_query_topk_dense_fallback_when_host_scale(monkeypatch):
+    """ADVICE r5 / ISSUE 7: when no device path can represent a request
+    (genuinely host-scale m), query_topk must serve it through the dense
     query() + host-selection path — same results and tie order — instead
     of raising."""
     from randomprojection_tpu.models import sketch as sk
@@ -407,7 +407,9 @@ def test_query_topk_dense_fallback_when_key_overflows(monkeypatch):
     idx = sk.SimHashIndex(B)
     ref_d, ref_i = idx.query_topk(A, 5)
 
-    monkeypatch.setattr(sk, "_topk_key_fits_int32", lambda *a: False)
+    monkeypatch.setattr(
+        sk.SimHashIndex, "_topk_route", lambda self, t, m: "dense"
+    )
     got_d, got_i = idx.query_topk(A, 5)
     np.testing.assert_array_equal(got_d, ref_d)
     np.testing.assert_array_equal(got_i, ref_i)

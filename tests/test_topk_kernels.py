@@ -1,0 +1,338 @@
+"""Kernel-parity suite for the fused Pallas top-k serving kernel
+(ISSUE 7): the fused kernel vs the retained scan path vs
+``topk_bruteforce``, bit for bit, at toy shapes — tombstone masking,
+ragged last blocks, tie-at-the-boundary ids, and an ``m`` the old
+packed-int32-key ceiling rejected, now served on device.
+
+Everything here runs on CPU through the Pallas interpreter (the same
+kernel body, DMAs and merge networks as the TPU path)."""
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu.models import sketch as sk
+from randomprojection_tpu.ops import topk_kernels as tk
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _filtered_reference(A, B, m, dead_ids=None):
+    """Brute-force (dist, lower-id) top-m with tombstoned columns forced
+    to lose — the same masked-selection contract as the device paths."""
+    D = sk.pairwise_hamming(A, B).astype(np.int64)
+    if dead_ids is not None and len(dead_ids):
+        D[:, np.asarray(dead_ids)] = B.shape[1] * 8 + 1
+    return sk._host_topk_select(D, m)
+
+
+def _three_way(idx, A, m, ref, tile=2048):
+    """Fused route, scan route, and the brute-force reference must agree
+    bit for bit (dist AND id — the tie order is part of the contract)."""
+    rd, ri = ref
+    d_f, i_f = idx.query_topk(A, m, tile=tile)
+    np.testing.assert_array_equal(d_f, rd)
+    np.testing.assert_array_equal(i_f, ri)
+    scan = sk.SimHashIndex.__new__(sk.SimHashIndex)
+    scan.__dict__.update(idx.__dict__)
+    scan.topk_impl = "scan"
+    scan._topk_fns = {}
+    scan._fused_degraded = set()
+    scan._scan_fallback_noted = set()
+    d_s, i_s = scan.query_topk(A, m, tile=tile)
+    np.testing.assert_array_equal(d_s, rd)
+    np.testing.assert_array_equal(i_s, ri)
+
+
+# toy analogs of benchmark.TOPK_BENCH_SHAPES: (index rows, code bytes,
+# queries, m, tile) — small enough for the interpreter, shaped to hit
+# multiple kernel blocks, ragged tails and (case 2) ragged multi-tile
+# dispatch.  Each extra distinct shape compiles fresh interpret programs
+# for BOTH impls, so the list stays tight.
+TOY_SHAPES = [
+    (2048, 32, 96, 16, 96),   # the smoke serving shape, scaled down
+    (1000, 8, 64, 9, 40),     # ragged rows AND a ragged last tile
+    (257, 4, 33, 33, 33),     # m > block candidates, odd everything
+]
+
+
+@pytest.mark.parametrize("rows,nb,nq,m,tile", TOY_SHAPES)
+def test_fused_vs_scan_vs_bruteforce(rows, nb, nq, m, tile):
+    rng = _rng(rows + nb)
+    B = rng.integers(0, 256, size=(rows, nb), dtype=np.uint8)
+    A = rng.integers(0, 256, size=(nq, nb), dtype=np.uint8)
+    idx = sk.SimHashIndex(B)
+    _three_way(idx, A, m, _filtered_reference(A, B, m), tile=tile)
+
+
+def test_parity_with_tombstones_and_chunks():
+    """Multi-chunk index with tombstones in some chunks only: the
+    masked fused variant runs beside the unmasked one and both match
+    the filtered brute force."""
+    rng = _rng(5)
+    nb = 8
+    parts = [rng.integers(0, 256, size=(n, nb), dtype=np.uint8)
+             for n in (500, 37, 300)]
+    B = np.concatenate(parts)
+    A = rng.integers(0, 256, size=(24, nb), dtype=np.uint8)
+    idx = sk.SimHashIndex(parts[0])
+    for p in parts[1:]:
+        idx.add(p)
+    dead = [0, 17, 499, 520, 700]  # chunks 0 and 1 and 2 touched
+    idx.delete(dead)
+    m = 11
+    _three_way(idx, A, m, _filtered_reference(A, B, m, dead))
+
+
+def test_parity_tie_heavy_boundary_ids():
+    """A corpus of few distinct codes: almost every selection decision
+    is a tie, broken by the LOWER global id — including ties that
+    straddle kernel block boundaries and the carry/block boundary."""
+    rng = _rng(9)
+    nb = 16
+    basis = rng.integers(0, 256, size=(3, nb), dtype=np.uint8)
+    B = basis[rng.integers(0, 3, 700)]
+    A = basis[rng.integers(0, 3, 24)]
+    idx = sk.SimHashIndex(B)
+    m = 25
+    _three_way(idx, A, m, _filtered_reference(A, B, m))
+
+
+def test_parity_ragged_last_block_and_nbits():
+    """Rows that leave a ragged last block at every block size the plan
+    can pick, plus a ragged bit width (pad bits cancel)."""
+    rng = _rng(3)
+    nb = 4
+    B = rng.integers(0, 256, size=(1025, nb), dtype=np.uint8)
+    # zero the pad bits of a ragged 27-bit code (27 bits in 4 bytes)
+    B[:, -1] &= 0x07
+    A = rng.integers(0, 256, size=(17, nb), dtype=np.uint8)
+    A[:, -1] &= 0x07
+    idx = sk.SimHashIndex(B, n_bits=27)
+    m = 7
+    _three_way(idx, A, m, _filtered_reference(A, B, m))
+
+
+def test_m_above_old_int32_key_ceiling_served_on_device():
+    """THE ceiling-removal acceptance (ISSUE 7): a request the old
+    packed-key bound rejected — ``(n_bits+2)·(m+blk) ≥ 2^31`` even at
+    the blk=8 clamp floor, the shape r5's machinery routed to the dense
+    fallback — is now served by the fused kernel, on the device path,
+    bit-identical to brute force.
+
+    2^24-bit codes (2 MiB/row) make the old sentinel so wide that even
+    m=120 overflowed the packed key.  The fused kernel's separate
+    (dist, idx) carries never pack over the carry, so the plan exists
+    and the kernel streams each huge row through byte-tiled,
+    double-buffered DMA.  (~270 MB host side, a few seconds in the
+    interpreter — the cheapest shape that genuinely crosses the old
+    bound, which requires n_bits·m ≳ 2^31.)"""
+    nb = 1 << 21
+    rows, m, nq = 128, 120, 1
+    sentinel = nb * 8 + 1
+    # restate the old bound: clamp to the blk=8 floor, then the fit test
+    blk = 32768
+    while blk > 8 and (sentinel + 1) * (m + blk) >= 2**31:
+        blk //= 2
+    assert (sentinel + 1) * (m + blk) >= 2**31, (
+        "shape no longer crosses the old int32-key ceiling — "
+        "the test would not prove the removal"
+    )
+    # the old routing would have dense-fallback'd; the new plan exists
+    assert tk.plan_fused(nq, rows, nb, m) is not None
+    rng = _rng(13)
+    B = rng.integers(0, 256, size=(rows, nb), dtype=np.uint8)
+    A = rng.integers(0, 256, size=(nq, nb), dtype=np.uint8)
+    from randomprojection_tpu.utils import telemetry
+
+    idx = sk.SimHashIndex(B)
+    assert idx._chunk_impl(nq, rows, m) == "fused"
+    before = telemetry.registry().snapshot()["counters"].get(
+        "simhash.topk_dense_fallbacks", 0
+    )
+    d, i = idx.query_topk(A, m)
+    after = telemetry.registry().snapshot()["counters"].get(
+        "simhash.topk_dense_fallbacks", 0
+    )
+    assert after == before, "the dense fallback must not fire"
+    rd, ri = _filtered_reference(A, B, m)
+    np.testing.assert_array_equal(d, rd)
+    np.testing.assert_array_equal(i, ri)
+
+
+def test_vmem_oom_degrades_to_scan_and_memoizes(monkeypatch):
+    """The r6-convention degraded retry: a scoped-VMEM OOM from the
+    fused kernel retries through the scan path (same results), records
+    the retry, and memoizes the shape so later dispatches skip the
+    failing kernel."""
+    from randomprojection_tpu.ops import topk_kernels
+    from randomprojection_tpu.utils import telemetry
+
+    rng = _rng(21)
+    B = rng.integers(0, 256, size=(300, 8), dtype=np.uint8)
+    A = rng.integers(0, 256, size=(20, 8), dtype=np.uint8)
+    idx = sk.SimHashIndex(B)
+    rd, ri = _filtered_reference(A, B, 5)
+
+    calls = {"n": 0}
+
+    def boom(*a, **kw):
+        calls["n"] += 1
+        raise RuntimeError(
+            "Mosaic failed: scoped vmem allocation exceeds the limit"
+        )
+
+    monkeypatch.setattr(topk_kernels, "fused_topk", boom)
+    before = telemetry.registry().snapshot()["counters"].get(
+        "backend.vmem_oom_retries", 0
+    )
+    d, i = idx.query_topk(A, 5)
+    np.testing.assert_array_equal(d, rd)
+    np.testing.assert_array_equal(i, ri)
+    assert calls["n"] == 1
+    after = telemetry.registry().snapshot()["counters"].get(
+        "backend.vmem_oom_retries", 0
+    )
+    assert after == before + 1
+    assert idx._fused_degraded  # memoized
+    # second call: fused not attempted again for the memoized shape
+    d2, i2 = idx.query_topk(A, 5)
+    np.testing.assert_array_equal(d2, rd)
+    assert calls["n"] == 1
+
+
+def test_vmem_oom_on_scan_unfit_shape_degrades_to_minimal_fused(monkeypatch):
+    """The ladder's other leg: when the scan path cannot represent the
+    request (the over-the-old-ceiling shapes), a VMEM OOM must degrade
+    WITHIN the kernel to the minimal tiling — still serving, still
+    bit-identical — never hit the scan builder's overflow guard."""
+    from randomprojection_tpu.ops import topk_kernels
+
+    rng = _rng(23)
+    B = rng.integers(0, 256, size=(300, 8), dtype=np.uint8)
+    A = rng.integers(0, 256, size=(20, 8), dtype=np.uint8)
+    idx = sk.SimHashIndex(B)
+    rd, ri = _filtered_reference(A, B, 5)
+    monkeypatch.setattr(
+        sk.SimHashIndex, "_scan_fits", lambda self, rows, m: False
+    )
+
+    real = topk_kernels.fused_topk
+    seen = {"plans": [], "oomed": False}
+
+    def oom_once_then_real(q, codes, n_real, m, *, dead=None, plan=None,
+                          interpret=None):
+        seen["plans"].append(plan)
+        if not seen["oomed"]:
+            seen["oomed"] = True
+            raise RuntimeError("scoped vmem allocation exceeds the limit")
+        return real(q, codes, n_real, m, dead=dead, plan=plan,
+                    interpret=interpret)
+
+    monkeypatch.setattr(topk_kernels, "fused_topk", oom_once_then_real)
+    d, i = idx.query_topk(A, 5)
+    np.testing.assert_array_equal(d, rd)
+    np.testing.assert_array_equal(i, ri)
+    assert idx._fused_degraded
+    # the retry carried the MINIMAL plan (smaller tiles than the auto one)
+    auto, mini = seen["plans"][0], seen["plans"][1]
+    assert (mini.tq, mini.blk) <= (auto.tq, auto.blk)
+    assert mini == topk_kernels.plan_fused(20, 300, 8, 5, minimal=True)
+    # subsequent dispatches stay on the minimal fused route
+    d2, _ = idx.query_topk(A, 5)
+    np.testing.assert_array_equal(d2, rd)
+    assert seen["plans"][-1] == mini
+
+
+def test_non_vmem_errors_are_not_swallowed(monkeypatch):
+    """Only classified VMEM OOMs take the degraded retry: any other
+    kernel failure must surface to the caller."""
+    from randomprojection_tpu.ops import topk_kernels
+
+    rng = _rng(22)
+    idx = sk.SimHashIndex(
+        rng.integers(0, 256, size=(64, 8), dtype=np.uint8)
+    )
+    monkeypatch.setattr(
+        topk_kernels, "fused_topk",
+        lambda *a, **kw: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    with pytest.raises(RuntimeError, match="boom"):
+        idx.query_topk(rng.integers(0, 256, size=(4, 8), dtype=np.uint8), 3)
+
+
+def test_topk_impl_validation_and_env_override(monkeypatch):
+    rng = _rng(30)
+    codes = rng.integers(0, 256, size=(64, 8), dtype=np.uint8)
+    with pytest.raises(ValueError, match="topk_impl"):
+        sk.SimHashIndex(codes, topk_impl="bogus")
+    idx = sk.SimHashIndex(codes)
+    assert idx._chunk_impl(4, 64, 3) == "fused"
+    monkeypatch.setenv("RP_TOPK_IMPL", "scan")
+    assert idx._chunk_impl(4, 64, 3) == "scan"
+    monkeypatch.delenv("RP_TOPK_IMPL")
+    assert idx._chunk_impl(4, 64, 3) == "fused"
+
+
+def test_kernel_dispatch_event_on_spine(tmp_path):
+    """The fused path records ``topk.kernel.dispatch`` events that the
+    doctor consumes into its serving section."""
+    from randomprojection_tpu.utils import telemetry, trace_report
+
+    rng = _rng(31)
+    idx = sk.SimHashIndex(
+        rng.integers(0, 256, size=(256, 8), dtype=np.uint8)
+    )
+    A = rng.integers(0, 256, size=(16, 8), dtype=np.uint8)
+    path = str(tmp_path / "events.jsonl")
+    telemetry.configure(path)
+    try:
+        idx.query_topk(A, 4)
+    finally:
+        telemetry.shutdown()
+    report = trace_report.build_report(path)
+    assert report["serving"]["topk_kernel_dispatches"] >= 1
+    assert report["serving"]["topk_kernel_queries"] >= 16
+    assert report["unregistered_events"] == {}
+    rendered = trace_report.render_report(report)
+    assert "fused top-k kernel" in rendered
+
+
+def test_plan_fused_bounds():
+    """Plan feasibility: normal shapes plan; host-scale m and
+    pathologically wide codes do not (the dense fallback's territory)."""
+    assert tk.plan_fused(2048, 1 << 20, 32, 16) is not None
+    # m whose carry cannot fit VMEM even at one query row
+    assert tk.plan_fused(8, 1 << 22, 32, 1 << 22) is None
+    # codes beyond f32-exact Hamming (> 2^24 bits)
+    assert tk.plan_fused(8, 64, (1 << 21) + 8, 4) is None
+
+
+def test_scan_fallback_event_when_unplannable(monkeypatch):
+    """When auto routing wants the kernel but no tiling fits, the scan
+    path serves and the degradation lands on the spine once."""
+    from randomprojection_tpu.ops import topk_kernels
+    from randomprojection_tpu.utils import telemetry
+
+    rng = _rng(33)
+    B = rng.integers(0, 256, size=(128, 8), dtype=np.uint8)
+    A = rng.integers(0, 256, size=(8, 8), dtype=np.uint8)
+    idx = sk.SimHashIndex(B)
+    monkeypatch.setattr(topk_kernels, "plan_fused", lambda *a, **kw: None)
+    before = telemetry.registry().snapshot()["counters"].get(
+        "simhash.topk_scan_fallbacks", 0
+    )
+    d, i = idx.query_topk(A, 5)
+    rd, ri = _filtered_reference(A, B, 5)
+    np.testing.assert_array_equal(d, rd)
+    np.testing.assert_array_equal(i, ri)
+    after = telemetry.registry().snapshot()["counters"].get(
+        "simhash.topk_scan_fallbacks", 0
+    )
+    assert after == before + 1
+    idx.query_topk(A, 5)  # same shape: noted once, no double count
+    again = telemetry.registry().snapshot()["counters"].get(
+        "simhash.topk_scan_fallbacks", 0
+    )
+    assert again == after
