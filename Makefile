@@ -15,6 +15,10 @@
 #                      a toy index, parity-asserted against the scan
 #                      path and host brute force — run before tier-1 so
 #                      a broken serving kernel fails fast
+#   make shard-smoke   sharded serving tier (ISSUE 8) on the virtual
+#                      8-device CPU mesh: fused-per-shard == scan ==
+#                      brute force, cross-shard tombstones and >int32
+#                      global ids bit-identical
 #   make recover-smoke subprocess kill/resume harness at toy shapes:
 #                      SIGKILL the durable ingest at every injected
 #                      point, restart, assert the recovered index is
@@ -28,9 +32,9 @@ SHELL := /bin/bash
 PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
-.PHONY: verify lint tier1 kernel-smoke recover-smoke doctor-smoke
+.PHONY: verify lint tier1 kernel-smoke shard-smoke recover-smoke doctor-smoke
 
-verify: lint kernel-smoke recover-smoke tier1 doctor-smoke
+verify: lint kernel-smoke shard-smoke recover-smoke tier1 doctor-smoke
 
 lint:
 	$(PYTHON) -m randomprojection_tpu lint
@@ -50,6 +54,10 @@ kernel-smoke:
 	ds, js = scan.query_topk(A, 7); \
 	assert (ds == rd).all() and (js == ri).all(), 'scan/brute mismatch'; \
 	print('kernel-smoke OK: fused (interpret) == scan == brute force')"
+
+shard-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) -m randomprojection_tpu.serving.smoke
 
 recover-smoke:
 	rm -rf $(SMOKE_DIR)_recover && mkdir -p $(SMOKE_DIR)_recover

@@ -35,7 +35,15 @@ _LAZY_ESTIMATORS = (
     "topk_bruteforce",
 )
 
-_LAZY_DURABLE = ("DurableIngest", "save_index", "load_index")
+_LAZY_DURABLE = (
+    "DurableIngest",
+    "save_index",
+    "load_index",
+    "save_sharded_index",
+    "load_sharded_index",
+)
+
+_LAZY_SERVING = ("ShardedSimHashIndex", "ShardedTopKServer", "shard_devices")
 
 __all__ = [
     "johnson_lindenstrauss_min_dim",
@@ -43,6 +51,7 @@ __all__ = [
     "NotFittedError",
     *_LAZY_ESTIMATORS,
     *_LAZY_DURABLE,
+    *_LAZY_SERVING,
 ]
 
 
@@ -57,4 +66,8 @@ def __getattr__(name):
         from randomprojection_tpu import durable
 
         return getattr(durable, name)
+    if name in _LAZY_SERVING:
+        from randomprojection_tpu import serving
+
+        return getattr(serving, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

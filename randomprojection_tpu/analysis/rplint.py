@@ -108,6 +108,8 @@ HOT_MODULES = (
     "ops/pallas_kernels.py",
     "ops/topk_kernels.py",
     "models/sketch.py",
+    "serving/sharded_index.py",
+    "serving/server.py",
 )
 # RP06: modules on the pipeline/serving path where a swallowed error
 # strands a stream, a future, or a telemetry file

@@ -36,9 +36,9 @@ REGRESSION_THRESHOLD = 0.10
 # serving-bench composition without device-scale work
 TOPK_BENCH_SHAPES = {
     "full": dict(n_idx=1 << 24, q_tile=2048, clients=16, req_rows=128,
-                 reqs_per_client=4, max_batch=8192),
+                 reqs_per_client=4, max_batch=8192, shards=8, replicas=1),
     "smoke": dict(n_idx=1 << 18, q_tile=2048, clients=4, req_rows=64,
-                  reqs_per_client=2, max_batch=1024),
+                  reqs_per_client=2, max_batch=1024, shards=4, replicas=2),
 }
 
 PRESETS = {
@@ -828,6 +828,13 @@ def measure_config4_topk(preset: str = "full") -> dict:
       dispatch (plus the overlapped per-chunk d2h inside ``query_topk``
       itself).  Same results per request, amortized dispatch.
 
+    - ``sharded`` (ISSUE 8) — the same corpus as a
+      ``ShardedSimHashIndex`` (``shards`` per replica group,
+      ``replicas`` groups) served through ``ShardedTopKServer``'s
+      round-robin replica routing: records queries/s, per-shard
+      dispatch counts, cross-shard merge wall, and the replica batch
+      spread.
+
     Every timed call/request sees DISTINCT query values (sliced from a
     pregenerated pool — the call cache cannot serve it); d2h per query
     is the reported byte count, not the dense ``4·n_codes`` row."""
@@ -910,6 +917,93 @@ def measure_config4_topk(preset: str = "full") -> dict:
     )
     server_qps = n_requests * req_rows / server_elapsed
     server_executed = server_qps * 2 * n_idx * 256 / 1e12
+
+    # --- sharded tier (ISSUE 8): the SAME corpus row-sharded, served
+    # through replica-routed coalesced dispatches.  Each replica is a
+    # ShardedSimHashIndex (per-shard fused dispatch + one cross-shard
+    # merge); the server round-robins coalesced batches across
+    # replicas.  On a single-chip box the shards share one device (the
+    # merge/routing overhead is still real and measured); on a mesh
+    # each shard owns a chip.
+    shards, replicas = shape.get("shards", 0), shape.get("replicas", 1)
+    sharded = None
+    if shards:
+        from randomprojection_tpu.serving import (
+            ShardedSimHashIndex,
+            ShardedTopKServer,
+        )
+
+        groups = [
+            ShardedSimHashIndex(codes, n_shards=shards)
+            for _ in range(replicas)
+        ]
+        sh_server = ShardedTopKServer(
+            groups, m, max_batch=max_batch, max_delay_s=0.01,
+        )
+        # reuse the plain server's client harness against the sharded
+        # server (globals-free closure over sh_server via patching the
+        # submit target is uglier than a tiny local copy)
+
+        def sh_round(offset):
+            errs: list = []
+
+            def client(ci):
+                try:
+                    base = offset + ci * reqs_per_client
+                    futs = [
+                        sh_server.submit(
+                            spool[(base + r) * req_rows
+                                  : (base + r + 1) * req_rows]
+                        )
+                        for r in range(reqs_per_client)
+                    ]
+                    for f in futs:
+                        f.result()
+                except BaseException as e:  # surfaced after join
+                    errs.append(e)
+
+            threads = [
+                threading.Thread(target=client, args=(ci,), daemon=True)
+                for ci in range(clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errs:
+                raise errs[0]
+
+        sh_round(0)  # warm: compiles every shard's bucket
+        pre = [g.stats() for g in groups]
+        t0 = time.perf_counter()
+        sh_round(n_requests)
+        sh_elapsed = time.perf_counter() - t0
+        post = [g.stats() for g in groups]
+        sh_stats = sh_server.stats()
+        sh_server.close()
+        merges = sum(b["merges"] - a["merges"] for a, b in zip(pre, post))
+        merge_wall = sum(
+            b["merge_wall_s"] - a["merge_wall_s"]
+            for a, b in zip(pre, post)
+        )
+        sh_qps = n_requests * req_rows / sh_elapsed
+        sh_executed = sh_qps * 2 * n_idx * 256 / 1e12
+        sharded = {
+            "shards": shards,
+            "replicas": replicas,
+            "queries_per_s": round(sh_qps, 1),
+            "elapsed_s": round(sh_elapsed, 4),
+            # per-shard dispatch count of the timed round: every live
+            # shard is dispatched once per query tile (= per merge)
+            "dispatches_per_shard": merges // max(replicas, 1),
+            "shard_dispatches": merges * shards,
+            "merges": merges,
+            "merge_wall_s": round(merge_wall, 6),
+            "replica_batches": sh_stats["replica_batches"],
+            "executed_tflops": round(sh_executed, 1),
+            "timing_suspect": bool(sh_executed > 2 * V5E_PEAK_TFLOPS),
+        }
+
     return {
         "index_codes": n_idx,
         "m": m,
@@ -939,6 +1033,7 @@ def measure_config4_topk(preset: str = "full") -> dict:
         "d2h_bytes_per_query": 2 * 4 * m,
         "dense_d2h_bytes_per_query": 4 * n_idx,
         "checksum": int(last[0][0, 0]) if last is not None else None,
+        "sharded": sharded,
     }
 
 
@@ -1135,6 +1230,10 @@ def bench_rates(record: dict) -> dict:
         put("config4.topk.single_stream_queries_per_s",
             c4.get("topk_serving"), "single_stream_queries_per_s",
             "single_stream_timing_suspect")
+        put("config4.topk.sharded_queries_per_s",
+            (c4.get("topk_serving") or {}).get("sharded")
+            if isinstance(c4.get("topk_serving"), dict) else None,
+            "queries_per_s", "timing_suspect")
         if "config4.topk.queries_per_s" not in rates:
             # compact-line records flatten topk_serving.queries_per_s to
             # topk_queries_per_s (suspect flag: topk_timing_suspect) — a
@@ -1142,6 +1241,9 @@ def bench_rates(record: dict) -> dict:
             # still gate the serving rate
             put("config4.topk.queries_per_s", c4, "topk_queries_per_s",
                 "topk_timing_suspect")
+        if "config4.topk.sharded_queries_per_s" not in rates:
+            put("config4.topk.sharded_queries_per_s", c4,
+                "topk_sharded_queries_per_s", "topk_sharded_timing_suspect")
     c5 = record.get("config5")
     put("config5.ingest_tokens_per_s", c5, "ingest_tokens_per_s",
         "ingest_host_suspect")
@@ -1301,6 +1403,16 @@ def compact_summary(record: dict) -> dict:
             # config4 kernel — the flattened digest must keep ITS flag or
             # a suspect serving rate becomes a trusted baseline
             c4d["topk_timing_suspect"] = bool(tk["timing_suspect"])
+        sh = tk.get("sharded")
+        if isinstance(sh, dict) and "queries_per_s" in sh:
+            # sharded-tier digest (ISSUE 8): enough to gate the rate and
+            # reconstruct the layout, flat so the ≤2 KB bound holds
+            c4d["topk_sharded_queries_per_s"] = _sig(sh["queries_per_s"])
+            c4d["topk_sharded_shards"] = sh.get("shards")
+            c4d["topk_sharded_replicas"] = sh.get("replicas")
+            c4d["topk_sharded_timing_suspect"] = bool(
+                sh.get("timing_suspect")
+            )
     regs = record.get("regressions", [])
     if len(regs) > 8:
         c["regressions_truncated"] = len(regs) - 8

@@ -261,6 +261,14 @@ def build_parser():
                    help="query_topk device path: 'auto' (default) serves "
                         "via the fused Pallas kernel where plannable, "
                         "'scan' pins the retained lax.scan reference path")
+    q.add_argument("--shards", type=int, default=0,
+                   help="also measure the sharded tier: row-shard the "
+                        "corpus over this many shard devices "
+                        "(serving.ShardedSimHashIndex; 0 = skip)")
+    q.add_argument("--replicas", type=_positive_int, default=1,
+                   help="replica groups for the sharded tier; coalesced "
+                        "batches route round-robin across them "
+                        "(serving.ShardedTopKServer)")
     q.add_argument("--seed", type=int, default=0)
     _add_observability(q)
 
@@ -670,6 +678,65 @@ def cmd_topk_bench(args):
     server.close()
     server_qps = len(requests) * args.request_rows / server_elapsed
 
+    sharded = None
+    if args.shards:
+        from randomprojection_tpu.serving import (
+            ShardedSimHashIndex,
+            ShardedTopKServer,
+        )
+
+        groups = [
+            ShardedSimHashIndex(
+                codes, n_shards=args.shards, topk_impl=args.topk_impl
+            )
+            for _ in range(args.replicas)
+        ]
+        sh_server = ShardedTopKServer(
+            groups, args.m, max_batch=args.server_batch,
+            max_delay_s=args.server_delay_ms / 1e3,
+        )
+        sh_server.query(requests[0])  # warm every shard's bucket
+        pre = [g.stats() for g in groups]
+        sh_results: list = [[] for _ in range(args.clients)]
+
+        def sh_client(reqs, out):
+            # client() above is bound to the plain server; this one
+            # submits the same request stream to the sharded tier
+            futs = [sh_server.submit(r) for r in reqs]
+            out.extend(f.result() for f in futs)
+
+        sh_threads = [
+            threading.Thread(
+                target=sh_client, args=(per_client[i], sh_results[i]),
+                daemon=True,
+            )
+            for i in range(args.clients)
+        ]
+        t0 = time.perf_counter()
+        for t in sh_threads:
+            t.start()
+        for t in sh_threads:
+            t.join()
+        sh_elapsed = time.perf_counter() - t0
+        sh_stats = sh_server.stats()
+        sh_server.close()
+        post = [g.stats() for g in groups]
+        sharded = {
+            "shards": args.shards,
+            "replicas": args.replicas,
+            "queries_per_s": round(
+                len(requests) * args.request_rows / sh_elapsed, 1
+            ),
+            "merges": sum(
+                b["merges"] - a["merges"] for a, b in zip(pre, post)
+            ),
+            "merge_wall_s": round(sum(
+                b["merge_wall_s"] - a["merge_wall_s"]
+                for a, b in zip(pre, post)
+            ), 6),
+            "replica_batches": sh_stats["replica_batches"],
+        }
+
     print(json.dumps({
         "metric": f"simhash top-k serving queries/s (m={args.m}, "
                   f"{args.index_codes} codes)",
@@ -689,6 +756,7 @@ def cmd_topk_bench(args):
         "server_batch": args.server_batch,
         "server_delay_ms": args.server_delay_ms,
         **{f"server_{k}": v for k, v in server.stats().items()},
+        **({"sharded": sharded} if sharded else {}),
     }))
     _write_openmetrics(args)
 

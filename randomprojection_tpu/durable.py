@@ -70,6 +70,8 @@ __all__ = [
     "DurableIngest",
     "save_index",
     "load_index",
+    "save_sharded_index",
+    "load_sharded_index",
     "read_manifest",
     "verify_snapshot",
     "check_coverage",
@@ -359,12 +361,24 @@ def load_index(dirpath: str, *, mesh=None, data_axis: str = "data"):
     + ``recover.checksum_mismatch``), chunk structure and global id
     assignment are restored exactly, and the tombstone bitmap (if any)
     is re-armed.  ``mesh`` re-shards the restored chunks — the snapshot
-    format is mesh-agnostic.
+    format is mesh-agnostic, and a snapshot written by the SHARDED tier
+    (``save_sharded_index`` spills in global id order) loads here as a
+    plain single-device index with identical query results.
     """
     from randomprojection_tpu.models.sketch import SimHashIndex
 
     manifest = read_manifest(dirpath)
     check_coverage(manifest)
+    if manifest.get("id_offset"):
+        # a plain SimHashIndex has no id-offset concept: loading would
+        # silently renumber the corpus to 0-based ids — refuse and point
+        # at the loader that restores the offset
+        raise ValueError(
+            f"{dirpath} was saved with id_offset="
+            f"{manifest['id_offset']} (a sharded-tier global id space); "
+            "load it with ShardedSimHashIndex.load / "
+            "durable.load_sharded_index, which restores the offset"
+        )
     index = SimHashIndex(
         np.empty((0, manifest["n_bytes"]), np.uint8),
         n_bits=manifest["n_bits"], mesh=mesh, data_axis=data_axis,
@@ -400,6 +414,141 @@ def load_index(dirpath: str, *, mesh=None, data_axis: str = "data"):
         EVENTS.INDEX_SNAPSHOT_LOAD, path=dirpath,
         generation=manifest["generation"], chunks=len(manifest["chunks"]),
         n_codes=int(index.n_codes), deleted=int(index.n_deleted),
+    )
+    return index
+
+
+def save_sharded_index(index, dirpath: str) -> dict:
+    """Durable snapshot of a ``serving.ShardedSimHashIndex`` — the
+    MESH-AGNOSTIC layout: one spill per segment (= per shard chunk) in
+    **global id order**, so the on-disk format is exactly a plain index
+    snapshot of the concatenated corpus (same manifest kind, same
+    coverage invariant, same per-chunk SHA-256 verification) plus two
+    provenance fields: ``sharded`` records the writing layout, and
+    ``id_offset`` (when nonzero) the global id base.  Consequences, by
+    construction:
+
+    - restore under ANY shard count (``load_sharded_index``) or as a
+      plain single-device ``SimHashIndex`` (``load_index``, when
+      ``id_offset`` is 0) — query results are bit-identical because
+      global ids and the (distance, lower-global-id) merge order are
+      layout-independent;
+    - the same torn-write-impossible commit discipline as
+      ``save_index`` (generation-numbered spills, manifest replaced
+      LAST, old generation swept only after the commit).
+
+    Tombstones persist as the GLOBAL bitmap — a deleted range that
+    spans shard boundaries round-trips to whatever boundaries the
+    loading layout has.  Returns the committed manifest."""
+    os.makedirs(dirpath, exist_ok=True)
+    old = None
+    try:
+        old = read_manifest(dirpath)
+    except FileNotFoundError:
+        pass
+    except ValueError:
+        pass  # corrupt manifest: re-save repairs (same policy as save_index)
+    if old is not None:
+        gen = old.get("generation", 0) + 1
+    else:
+        gen = _next_generation_from_files(dirpath)
+    entries = []
+    for seq, (g0, rows) in enumerate(index._iter_segment_host()):
+        entries.append(_spill_chunk(dirpath, gen, seq, rows, g0))
+    tomb = None
+    dead = index._dead_global()
+    if dead is not None:
+        packed = np.packbits(dead, bitorder="little")
+        fname = f"tombstones-{gen:06d}.npy"
+        _write_npy_atomic(os.path.join(dirpath, fname), packed)
+        tomb = {
+            "file": fname, "deleted": int(index.n_deleted),
+            "sha256": _sha256(packed),
+        }
+    manifest = {
+        "format_version": INDEX_FORMAT_VERSION,
+        "kind": "simhash_index",
+        "n_bytes": int(index.n_bytes),
+        "n_bits": int(index.n_bits),
+        "n_codes": int(index.n_codes),
+        "generation": gen,
+        "chunks": entries,
+        "tombstones": tomb,
+        "sharded": {"shards": int(index.n_shards)},
+    }
+    if index.id_offset:
+        manifest["id_offset"] = int(index.id_offset)
+    check_coverage(manifest)  # the writer holds itself to the invariant
+    _commit_manifest(dirpath, manifest)
+    for fn in _scan_orphans(dirpath, manifest):
+        os.unlink(os.path.join(dirpath, fn))
+    telemetry.emit(
+        EVENTS.INDEX_SNAPSHOT_SAVE, path=dirpath, generation=gen,
+        chunks=len(entries), n_codes=int(index.n_codes),
+        deleted=int(index.n_deleted), shards=int(index.n_shards),
+    )
+    return manifest
+
+
+def load_sharded_index(dirpath: str, *, mesh=None, devices=None,
+                       n_shards=None, data_axis: str = "data",
+                       topk_impl: str = "auto"):
+    """Rebuild a ``serving.ShardedSimHashIndex`` from a snapshot
+    directory onto ANY shard layout (``mesh`` / ``devices`` /
+    ``n_shards`` — resolution as in ``serving.shard_devices``).  Works
+    on snapshots written by ``save_sharded_index`` AND on plain
+    ``save_index`` snapshots (both store the corpus in global id
+    order); every chunk is checksum-verified BEFORE any upload, the
+    corpus re-shards balanced over the new layout, the tombstone
+    bitmap re-arms at the new shard boundaries, and ``id_offset``
+    restores from the manifest — so ``query_topk`` answers are
+    bit-identical to the saved index's, whatever layout wrote it."""
+    from randomprojection_tpu.serving.sharded_index import ShardedSimHashIndex
+
+    manifest = read_manifest(dirpath)
+    check_coverage(manifest)
+    parts = []
+    for entry in manifest["chunks"]:
+        arr = _load_chunk_verified(dirpath, entry)
+        if arr.ndim != 2 or arr.shape != (entry["rows"], manifest["n_bytes"]):
+            raise ValueError(
+                f"snapshot chunk {entry['file']} has shape {arr.shape}, "
+                f"manifest says ({entry['rows']}, {manifest['n_bytes']})"
+            )
+        parts.append(arr)
+    codes = (
+        np.concatenate(parts, axis=0)
+        if parts
+        else np.empty((0, manifest["n_bytes"]), np.uint8)
+    )
+    if codes.shape[0] != manifest["n_codes"]:
+        raise ValueError(
+            f"restored {codes.shape[0]} codes but the manifest records "
+            f"{manifest['n_codes']}"
+        )
+    id_offset = int(manifest.get("id_offset", 0))
+    index = ShardedSimHashIndex(
+        codes, mesh=mesh, devices=devices, n_shards=n_shards,
+        data_axis=data_axis, n_bits=manifest["n_bits"],
+        topk_impl=topk_impl, id_offset=id_offset,
+    )
+    tomb = manifest.get("tombstones")
+    if tomb:
+        packed = _load_chunk_verified(dirpath, tomb)
+        dead = np.unpackbits(
+            packed, count=manifest["n_codes"], bitorder="little"
+        ).astype(bool)
+        if int(dead.sum()) != tomb["deleted"]:
+            raise ValueError(
+                f"tombstone bitmap in {dirpath} marks {int(dead.sum())} "
+                f"codes deleted but the manifest records {tomb['deleted']}"
+            )
+        index.delete(np.flatnonzero(dead).astype(np.int64) + id_offset)
+    telemetry.emit(
+        EVENTS.INDEX_SNAPSHOT_LOAD, path=dirpath,
+        generation=manifest["generation"], chunks=len(manifest["chunks"]),
+        n_codes=int(index.n_codes), deleted=int(index.n_deleted),
+        shards=int(index.n_shards),
     )
     return index
 
@@ -443,6 +592,8 @@ def _verify_manifest(dirpath: str, manifest: dict, status: dict) -> dict:
         "chunks": len(manifest["chunks"]),
         "deleted": (manifest.get("tombstones") or {}).get("deleted", 0),
         "rows_done": (manifest.get("ingest") or {}).get("rows_done"),
+        "sharded": (manifest.get("sharded") or {}).get("shards"),
+        "id_offset": manifest.get("id_offset", 0),
     })
     corrupt = []
     entries = list(manifest["chunks"])

@@ -287,8 +287,16 @@ class SimHashIndex:
 
     Capacity: at most ``2**31 - 1`` codes per index — device ids are
     int32 end to end, so ``add`` refuses past that rather than silently
-    wrapping global ids (scale out further by sharding more chips over a
-    mesh, which divides rows without widening the id space).
+    wrapping ids.  This is a PER-SHARD invariant, not the ceiling of the
+    system: ``serving.ShardedSimHashIndex`` row-shards a corpus over
+    many of these indexes (one per device) and widens ids to int64 at
+    its merge boundary, so the aggregate corpus is bounded by devices,
+    not by int32.
+
+    ``device=`` pins every upload and query tile to one specific
+    device (``jax.Device``) instead of the platform default — the
+    per-shard placement the sharded tier is built from; ``label`` names
+    the index in capacity errors so a full shard identifies itself.
 
     Thread-safety: queries may run concurrently with each other, but
     MUTATION (``add``/``delete``/``compact``) requires the index to be
@@ -300,14 +308,22 @@ class SimHashIndex:
     _TOPK_IMPLS = ("auto", "fused", "scan")
 
     def __init__(self, codes, *, mesh=None, data_axis: str = "data",
-                 n_bits: Optional[int] = None, topk_impl: str = "auto"):
+                 n_bits: Optional[int] = None, topk_impl: str = "auto",
+                 device=None, label: Optional[str] = None):
         if topk_impl not in self._TOPK_IMPLS:
             raise ValueError(
                 f"topk_impl must be one of {self._TOPK_IMPLS}, "
                 f"got {topk_impl!r}"
             )
+        if device is not None and mesh is not None:
+            raise ValueError(
+                "device= pins a single-device index; it cannot combine "
+                "with mesh= (one index is one shard OR one shard_map span)"
+            )
         self.mesh = mesh
         self.data_axis = data_axis
+        self.device = device
+        self.label = label
         # 'auto' = the fused Pallas kernel wherever it can serve (the
         # default device path; interpreter-mode off-TPU), scan for the
         # mesh path and degraded retries; 'scan' pins the retained
@@ -353,16 +369,28 @@ class SimHashIndex:
         n = codes.shape[0]
         if self.n_codes + n >= 2**31:
             # every device-side id (row0, local_ids, best_i) and the
-            # returned idx are int32: past 2^31-1 codes, global ids would
+            # returned idx are int32: past 2^31-1 codes, local ids would
             # silently wrap and query_topk would return wrong neighbors.
-            # The beyond-one-HBM growth story is sharding more chips over
-            # the SAME id space, not widening it — refuse loudly here.
+            # The per-index bound is deliberate — the beyond-int32 growth
+            # story is ShardedSimHashIndex, whose GLOBAL ids are int64
+            # while each shard keeps int32 locals — so refuse loudly
+            # here, naming the shard when this index is one.
+            who = (
+                f"SimHashIndex {self.label!r}" if self.label
+                else "SimHashIndex"
+            )
             raise ValueError(
-                f"SimHashIndex is limited to 2**31 - 1 codes (int32 device "
-                f"ids); have {self.n_codes}, adding {n} would overflow"
+                f"{who} is limited to 2**31 - 1 codes (int32 device-local "
+                f"ids); have {self.n_codes}, adding {n} would overflow. "
+                "Grow past int32 by sharding over more devices "
+                "(serving.ShardedSimHashIndex keeps global ids int64 and "
+                "this bound per shard)"
             )
         if self.mesh is None:
-            b = jnp.asarray(codes)
+            if self.device is not None:
+                b = jax.device_put(codes, self.device)
+            else:
+                b = jnp.asarray(codes)
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -466,9 +494,7 @@ class SimHashIndex:
             mask = np.zeros(chunk.b.shape[0], dtype=np.uint8)
             mask[: chunk.n] = sl
             if self.mesh is None:
-                import jax.numpy as jnp
-
-                dev = jnp.asarray(mask)
+                dev = self._device_queries(mask)
             else:
                 import jax
                 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -479,6 +505,19 @@ class SimHashIndex:
         chunk.dead_dev = dev
         chunk.dead_rev = self._dead_rev
         return dev
+
+    def _device_queries(self, a_np):
+        """Upload one host operand to wherever this index lives: the
+        pinned ``device`` when set (per-shard placement), else the
+        platform default.  The jitted kernels follow the committed
+        operands, so a pinned index computes entirely on its own device
+        with no cross-device hops."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.device is not None:
+            return jax.device_put(a_np, self.device)
+        return jnp.asarray(a_np)
 
     def _fetch_chunk_host(self, chunk) -> np.ndarray:
         """Host copy of one chunk's REAL rows — a deliberate full-chunk
@@ -600,8 +639,6 @@ class SimHashIndex:
         ``copy_to_host_async`` at dispatch and materialize one tile
         behind, so the transfer of tile ``i`` rides under tile ``i+1``'s
         compute instead of blocking the dispatch loop."""
-        import jax.numpy as jnp
-
         A = self._check_queries(A)
         fn = self._query_fn()
         out = np.empty((A.shape[0], self.n_codes), dtype=np.int32)
@@ -617,7 +654,7 @@ class SimHashIndex:
 
         for lo in range(0, A.shape[0], tile):
             hi = min(lo + tile, A.shape[0])
-            a = jnp.asarray(A[lo:hi])
+            a = self._device_queries(A[lo:hi])
             handles = []
             for c in self._chunks:
                 h = fn(a, c.b)
@@ -717,8 +754,6 @@ class SimHashIndex:
                 "query_topk on an index whose codes are all deleted "
                 "(tombstoned); compact() or add() live codes first"
             )
-        import jax.numpy as jnp
-
         # m_eff counts LIVE codes only: tombstoned rows are masked to the
         # sentinel distance before selection (device path) or before the
         # host select (dense fallback), so they can never win — and the
@@ -749,10 +784,6 @@ class SimHashIndex:
         nq = A.shape[0]
         out_d = np.empty((nq, m_eff), dtype=np.int32)
         out_i = np.empty((nq, m_eff), dtype=np.int32)
-        # global id shift for the cross-chunk host merge: distances fit
-        # n_bits ≤ 2^15 and ids fit int32, so (dist << shift) | id is an
-        # exact int64 total-order key
-        shift = max(self.n_codes.bit_length(), 1)
         # the per-chunk candidate fetch used to block (np.asarray per
         # chunk) INSIDE the dispatch loop, serializing device compute
         # with d2h and the host merge; now every chunk result starts its
@@ -763,54 +794,82 @@ class SimHashIndex:
 
         def finish(entry):
             lo, hi, handles = entry
-            cand_d, cand_i = [], []
-            base = 0
-            for c, (d, i) in zip(self._chunks, handles):
-                # rplint: allow[RP03] — d2h already started at dispatch
-                cand_d.append(np.asarray(d))
-                # rplint: allow[RP03] — d2h already started at dispatch
-                cand_i.append(np.asarray(i).astype(np.int64) + base)
-                base += c.n
-            d = np.concatenate(cand_d, axis=1)
-            i = np.concatenate(cand_i, axis=1)
-            # clamp sentinel ids (empty per-shard slots carry id 2^31-1)
-            # so they cannot bleed into the dist bits of the merge key;
-            # their sentinel dist (> n_bits) already orders them last
-            key = (d.astype(np.int64) << shift) | np.minimum(
-                i, (1 << shift) - 1
-            )
-            sel = np.argsort(key, axis=1, kind="stable")[:, :m_eff]
-            out_d[lo:hi] = np.take_along_axis(d, sel, axis=1)
-            out_i[lo:hi] = np.take_along_axis(i, sel, axis=1).astype(
-                np.int32
-            )
+            d, i = self._topk_finish_tile(handles, m_eff)
+            out_d[lo:hi] = d
+            out_i[lo:hi] = i
 
         for lo in range(0, nq, tile):
             hi = min(lo + tile, nq)
-            a = jnp.asarray(A[lo:hi])
-            handles = []
-            for c in self._chunks:
-                m_c = int(min(m_eff, c.n))
-                d, i = self._chunk_topk(a, c, m_c)
-                _start_host_copy(d)
-                _start_host_copy(i)
-                handles.append((d, i))
-            telemetry.registry().counter_inc(
-                "simhash.chunk_dispatches", len(self._chunks)
+            pending.append(
+                (lo, hi, self._topk_dispatch_tile(A[lo:hi], m_eff))
             )
-            if telemetry.enabled():
-                telemetry.emit(
-                    EVENTS.SIMHASH_TOPK_TILE, queries=int(hi - lo),
-                    m=int(m_eff),
-                    chunks=len(self._chunks), n_codes=self.n_codes,
-                    **telemetry.trace_fields(),
-                )
-            pending.append((lo, hi, handles))
             if len(pending) >= 2:
                 finish(pending.pop(0))
         while pending:
             finish(pending.pop(0))
         return out_d, out_i
+
+    # -- tile-level dispatch/finish halves (shared with the sharded tier) ----
+
+    def _topk_dispatch_tile(self, a_np, m_eff: int) -> list:
+        """Dispatch one query tile against every resident chunk and
+        START each result's d2h; returns the per-chunk ``(dist, idx)``
+        device-handle list for ``_topk_finish_tile``.  The split exists
+        so a caller holding MANY single-device indexes — the sharded
+        serving tier, one of these per shard device — can fan a tile
+        out across all of them before fetching any, overlapping every
+        shard's compute (dispatch is async; a dispatch-then-fetch loop
+        per shard would serialize the whole mesh)."""
+        a = self._device_queries(a_np)
+        handles = []
+        for c in self._chunks:
+            m_c = int(min(m_eff, c.n))
+            d, i = self._chunk_topk(a, c, m_c)
+            _start_host_copy(d)
+            _start_host_copy(i)
+            handles.append((d, i))
+        telemetry.registry().counter_inc(
+            "simhash.chunk_dispatches", len(self._chunks)
+        )
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.SIMHASH_TOPK_TILE, queries=int(a_np.shape[0]),
+                m=int(m_eff),
+                chunks=len(self._chunks), n_codes=self.n_codes,
+                **telemetry.trace_fields(),
+            )
+        return handles
+
+    def _topk_finish_tile(self, handles: list, m_eff: int):
+        """Materialize one dispatched tile's per-chunk candidates and
+        merge them across chunks under the (distance, lower-id) total
+        order.  Returns ``(dist, idx)`` host arrays, each
+        ``(tile_rows, m_eff)`` int32 with ``idx`` index-local."""
+        # local id shift for the cross-chunk host merge: distances fit
+        # n_bits ≤ 2^15 and ids fit int32, so (dist << shift) | id is an
+        # exact int64 total-order key
+        shift = max(self.n_codes.bit_length(), 1)
+        cand_d, cand_i = [], []
+        base = 0
+        for c, (d, i) in zip(self._chunks, handles):
+            # rplint: allow[RP03] — d2h already started at dispatch
+            cand_d.append(np.asarray(d))
+            # rplint: allow[RP03] — d2h already started at dispatch
+            cand_i.append(np.asarray(i).astype(np.int64) + base)
+            base += c.n
+        d = np.concatenate(cand_d, axis=1)
+        i = np.concatenate(cand_i, axis=1)
+        # clamp sentinel ids (empty per-shard slots carry id 2^31-1)
+        # so they cannot bleed into the dist bits of the merge key;
+        # their sentinel dist (> n_bits) already orders them last
+        key = (d.astype(np.int64) << shift) | np.minimum(
+            i, (1 << shift) - 1
+        )
+        sel = np.argsort(key, axis=1, kind="stable")[:, :m_eff]
+        return (
+            np.take_along_axis(d, sel, axis=1),
+            np.take_along_axis(i, sel, axis=1).astype(np.int32),
+        )
 
     def _topk_impl_pref(self) -> str:
         """Constructor preference, overridable per process via the
@@ -1318,6 +1377,20 @@ class TopKServer:
 
     # -- dispatcher ---------------------------------------------------------
 
+    def _pick_index(self):
+        """The index one coalesced dispatch runs against.  Hook for the
+        sharded tier: ``serving.ShardedTopKServer`` overrides this to
+        round-robin across replica groups (dispatcher-thread-only, so
+        no locking)."""
+        return self.index
+
+    def _batch_served(self, index, rows: int, padded: int,
+                      requests: int, wall: float) -> None:
+        """Post-success hook per coalesced dispatch (dispatcher thread).
+        The base server's accounting lives in ``_serve``; the sharded
+        tier adds its ``serve.shard.*`` counters and routing event
+        here."""
+
     def _collect(self, first):
         """One coalesced batch: ``first`` plus whatever arrives within
         ``max_delay_s``, capped at ``max_batch`` rows.  Returns
@@ -1360,9 +1433,10 @@ class TopKServer:
         pad_to = row_bucket(n)
         if pad_to != n:
             arr = np.pad(arr, ((0, pad_to - n), (0, 0)))
+        index = self._pick_index()
         t0 = _time.perf_counter()
         try:
-            d, i = self.index.query_topk(arr, self.m, tile=pad_to)
+            d, i = index.query_topk(arr, self.m, tile=pad_to)
         except BaseException as e:
             # the exception reaches every caller through its future, but
             # an unobserved future would swallow it silently — record the
@@ -1390,6 +1464,7 @@ class TopKServer:
                 requests=len(batch), m=int(self.m),
                 wall_s=round(wall, 6),
             )
+        self._batch_served(index, n, pad_to, len(batch), wall)
         lo = 0
         for codes, fut in batch:
             hi = lo + codes.shape[0]
@@ -1441,6 +1516,52 @@ class DeviceBatch:
         self.t_pad = t_pad
         self.shape = shape
         self.nbytes = nbytes
+
+
+def _flat_mesh_layout(X, p: int):
+    """Token-balanced host layout of one CSR batch for the flat mesh
+    kernel (ISSUE 8 satellite — VERDICT weak #3): rows partition at
+    ``token_balanced_bounds`` cuts instead of equal row counts, so the
+    padded token width ``t_pad`` tracks ``nnz/p`` instead of the worst
+    shard's token count.  Shards therefore own UNEQUAL row ranges; each
+    scatters into its own ``rows_blk``-row block (``rows_blk`` = the
+    bucketed max rows any shard owns), and ``perm`` maps the
+    block-concatenated output back to global row order (one device
+    gather).  Returns ``(rows_l, idx, vals, rows_blk, t_pad, perm)``
+    with the first three ``(p, t_pad)`` and ``perm`` ``(n,)`` int32.
+
+    Pure host work — factored out of ``_transform_csr_jax`` so the
+    partition/permutation algebra is unit-testable off-mesh (the mesh
+    kernel itself needs a shard_map-capable jax)."""
+    from randomprojection_tpu.parallel.sharded import (
+        row_bucket,
+        token_balanced_bounds,
+    )
+
+    n = X.shape[0]
+    indptr = X.indptr.astype(np.int64, copy=False)
+    bounds_rows = token_balanced_bounds(indptr, p)
+    tok_bounds = indptr[bounds_rows]
+    rows_per = np.diff(bounds_rows)
+    counts = np.diff(tok_bounds)
+    rows_blk = row_bucket(int(max(rows_per.max(), 1)))
+    t_pad = row_bucket(int(max(counts.max(), 1)))
+    rows_l = np.zeros((p, t_pad), np.int32)
+    idx_s = np.zeros((p, t_pad), np.int32)
+    vals_s = np.zeros((p, t_pad), np.float32)
+    row_sizes = np.diff(indptr)
+    perm = np.empty(n, np.int64)
+    for s in range(p):
+        r0, r1 = int(bounds_rows[s]), int(bounds_rows[s + 1])
+        lo, hi = int(tok_bounds[s]), int(tok_bounds[s + 1])
+        c = hi - lo
+        rows_l[s, :c] = np.repeat(
+            np.arange(r1 - r0, dtype=np.int32), row_sizes[r0:r1]
+        )
+        idx_s[s, :c] = X.indices[lo:hi]
+        vals_s[s, :c] = X.data[lo:hi]
+        perm[r0:r1] = s * rows_blk + np.arange(r1 - r0)
+    return rows_l, idx_s, vals_s, rows_blk, t_pad, perm.astype(np.int32)
 
 
 def _docmajor_kernel(k: int, t_pad: int, chunk: int):
@@ -1743,14 +1864,14 @@ class CountSketch(ParamsMixin):
         rows up to +25% (``row_bucket``), and the flat index spans
         ``n_pad·k``, so guarding on the raw ``n`` would admit a narrow band
         of batches that overflow after padding.  Under a mesh the scatter
-        accumulator is PER SHARD (``_scatter_body(rps)``), so the guard
-        scales by the data-axis size — a batch the mesh path handles must
-        not be routed to the host fallback."""
+        accumulator is per shard, but the token-balanced row cuts can
+        hand one shard up to EVERY row of a fully-skewed batch — the
+        guard therefore uses the undivided bucket (conservative: a
+        pathological >2^31/k-row mesh batch routes to the host path
+        instead of risking a wrapped flat index)."""
         from randomprojection_tpu.parallel.sharded import row_bucket
 
         n_pad = row_bucket(max(X.shape[0], 1), self.mesh, self.data_axis)
-        if self.mesh is not None:
-            n_pad //= self.mesh.shape[self.data_axis]
         return (
             self._use_jax
             and X.dtype == np.float32
@@ -1910,19 +2031,16 @@ class CountSketch(ParamsMixin):
 
         Static shapes for one-program streams: token count and row count
         are padded on the octave ladder (``row_bucket``), pad tokens carry
-        value 0.  Under a mesh, rows shard over ``data_axis`` (DP): tokens
-        are partitioned at their shard's row boundaries on the host (CSR
-        ``indptr`` IS the partition), each shard scatters its own token
-        range into its own row block — zero collectives, same decomposition
-        as the dense path.
+        value 0.  Under a mesh, rows shard over ``data_axis`` (DP) at
+        TOKEN-BALANCED row cuts (``token_balanced_bounds`` — the split is
+        implicit in the CSR ``indptr``): each shard scatters its own
+        token range into its own row block with zero collectives, and
+        one device gather restores global row order.  The previous
+        equal-row split padded every shard's token buffer to the worst
+        shard's count (VERDICT weak #3); now ``t_pad`` tracks ``nnz/p``.
         """
         import jax
         import jax.numpy as jnp
-
-        from randomprojection_tpu.parallel.sharded import (
-            row_bucket,
-            slice_rows_sharded,
-        )
 
         n = X.shape[0]
         kind, n_pad, t_row = self._csr_route(X)
@@ -1937,35 +2055,25 @@ class CountSketch(ParamsMixin):
                 n, n_pad, t_pad, materialize=materialize,
             )
 
-        indptr = X.indptr.astype(np.int64, copy=False)
         fns = self.__dict__.setdefault("_csr_fns", {})
         h_dev, s_dev = self._device_tables()
 
-        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         p = self.mesh.shape[self.data_axis]
-        rps = n_pad // p  # rows per shard (row_bucket pads to 8p)
-        # shard s owns rows [s·rps, (s+1)·rps): its token range is
-        # indptr[lo]:indptr[hi] — the CSR layout is already partitioned
-        bounds = indptr[np.minimum(np.arange(p + 1) * rps, n)]
-        counts = np.diff(bounds)
-        t_pad = row_bucket(int(max(counts.max(), 1)))
-        rows_l = np.zeros((p, t_pad), dtype=np.int32)
-        idx_s = np.zeros((p, t_pad), dtype=np.int32)
-        vals_s = np.zeros((p, t_pad), dtype=np.float32)
-        row_sizes = np.diff(indptr)
-        for s in range(p):
-            lo, hi = int(bounds[s]), int(bounds[s + 1])
-            c = hi - lo
-            r0, r1 = s * rps, min((s + 1) * rps, n)
-            rows_l[s, :c] = np.repeat(
-                np.arange(r1 - r0, dtype=np.int32), row_sizes[r0:r1]
-            )
-            idx_s[s, :c] = X.indices[lo:hi]
-            vals_s[s, :c] = X.data[lo:hi]
-        fn = fns.get((n_pad, t_pad, p))
+        # token-balanced, row-aligned partition (ISSUE 8 satellite):
+        # shard row ranges come from the indptr's token quantiles, so
+        # t_pad tracks nnz/p instead of the worst shard's token count —
+        # the previous equal-row split padded EVERY shard to the most
+        # token-heavy shard (VERDICT weak #3).  Shards own unequal row
+        # counts; each scatters into its own rows_blk block and one
+        # device gather (perm) restores global row order.
+        rows_l, idx_s, vals_s, rows_blk, t_pad, perm = _flat_mesh_layout(
+            X, p
+        )
+        fn = fns.get(("flat_mesh", rows_blk, t_pad, p))
         if fn is None:
-            kernel = self._scatter_body(rps)
+            kernel = self._scatter_body(rows_blk)
 
             def shard_body(rows, idx, vals, h, s):
                 # operands arrive (1, t_pad) per shard: squeeze, then
@@ -1980,12 +2088,22 @@ class CountSketch(ParamsMixin):
                     out_specs=P(da, None),
                 )
             )
-            fns[(n_pad, t_pad, p)] = fn
+            fns[("flat_mesh", rows_blk, t_pad, p)] = fn
         y = fn(rows_l, idx_s, vals_s, h_dev, s_dev)
-        y = slice_rows_sharded(
-            y, n, self.mesh, self.data_axis,
-            cache=self.__dict__.setdefault("_slice_fns", {}),
-        )
+        # reassemble shard blocks to global row order: perm has exactly
+        # one entry per REAL row, so this gather also drops pad rows
+        # (replicated output — the row partition is batch-dependent, and
+        # XLA cannot slice a sharded dim raggedly; same policy as
+        # slice_rows_sharded's ragged leg)
+        gkey = ("flat_mesh_gather", rows_blk * p, n)
+        gfn = fns.get(gkey)
+        if gfn is None:
+            gfn = jax.jit(
+                lambda a, pm: jnp.take(a, pm, axis=0),
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+            fns[gkey] = gfn
+        y = gfn(y, jnp.asarray(perm))
         if materialize:
             return np.asarray(y)
         return y

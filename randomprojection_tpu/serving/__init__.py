@@ -1,0 +1,18 @@
+"""Sharded serving tier (ISSUE 8; ROADMAP open item 2).
+
+The layer between the fused top-k kernel (``ops/topk_kernels.py``) and
+the micro-batching server: ``ShardedSimHashIndex`` row-shards a SimHash
+corpus over many devices with a global-int64 / local-int32 id space and
+one cross-shard merge per query tile; ``ShardedTopKServer`` routes
+coalesced request batches round-robin across replica groups.  See
+``sharded_index.py`` for the id-space and merge-order arguments, and
+docs/ARCHITECTURE.md "Sharded serving tier".
+"""
+
+from randomprojection_tpu.serving.server import ShardedTopKServer
+from randomprojection_tpu.serving.sharded_index import (
+    ShardedSimHashIndex,
+    shard_devices,
+)
+
+__all__ = ["ShardedSimHashIndex", "ShardedTopKServer", "shard_devices"]

@@ -1,0 +1,108 @@
+"""Replica-aware serving front-end for the sharded tier.
+
+``TopKServer`` (models/sketch.py, r9/r10) already solves coalescing:
+concurrent small requests batch into one row-bucketed ``query_topk``
+dispatch with bounded delay, bounded queue and drain-on-close.  A
+``ShardedSimHashIndex`` plugs straight into it — the micro-batcher only
+needs ``query_topk``/``_check_queries`` — but one replica of a sharded
+corpus still serializes coalesced batches behind each other.
+
+``ShardedTopKServer`` adds the replica dimension: it holds N replica
+groups (each one full copy of the corpus — typically a
+``ShardedSimHashIndex`` spanning its own device set, or any index with
+the ``query_topk`` surface) and routes each coalesced dispatch to the
+next group **round-robin**, so consecutive batches land on disjoint
+devices and overlap.  Routing is dispatcher-thread-only — no locks —
+and results are replica-invariant by construction (replicas are
+validated to agree on corpus shape at construction; serving identical
+corpora is the operator's contract, exactly as "don't mutate a served
+index" already is).
+
+Telemetry: every routed dispatch emits ``serve.shard.batch`` (replica,
+shard fanout, rows, wall) and bumps the ``serve.shard.*`` counters the
+doctor's serving section reads, alongside the base server's
+``serve.topk.*`` accounting.
+"""
+
+from __future__ import annotations
+
+from randomprojection_tpu.models.sketch import TopKServer
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+__all__ = ["ShardedTopKServer"]
+
+
+class ShardedTopKServer(TopKServer):
+    """Micro-batching top-k server with round-robin replica routing
+    (see module docstring).  ``replicas`` is one index or a sequence of
+    replica indexes; everything else matches ``TopKServer``."""
+
+    def __init__(self, replicas, m: int, *, max_batch: int = 8192,
+                 max_delay_s: float = 0.002, max_pending: int = 8192,
+                 start: bool = True):
+        if not isinstance(replicas, (list, tuple)):
+            replicas = [replicas]
+        replicas = list(replicas)
+        if not replicas:
+            raise ValueError("ShardedTopKServer needs at least one replica")
+        first = replicas[0]
+        for r, rep in enumerate(replicas[1:], start=1):
+            if (
+                rep.n_bytes != first.n_bytes
+                or rep.n_bits != first.n_bits
+                or rep.n_codes != first.n_codes
+                or rep.n_live != first.n_live
+            ):
+                raise ValueError(
+                    f"replica {r} disagrees with replica 0 on corpus "
+                    f"shape (n_bytes {rep.n_bytes} vs {first.n_bytes}, "
+                    f"n_bits {rep.n_bits} vs {first.n_bits}, "
+                    f"n_codes {rep.n_codes} vs {first.n_codes}, n_live "
+                    f"{rep.n_live} vs {first.n_live}): replicas must "
+                    "serve identical corpora or results become "
+                    "routing-dependent"
+                )
+        self.replicas = replicas
+        self._rr = 0  # dispatcher-thread-private round-robin cursor
+        self._replica_batches = [0] * len(replicas)
+        super().__init__(
+            first, m, max_batch=max_batch, max_delay_s=max_delay_s,
+            max_pending=max_pending, start=start,
+        )
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.replicas)
+
+    def _pick_index(self):
+        r = self._rr % len(self.replicas)
+        self._rr += 1
+        self._picked = r
+        return self.replicas[r]
+
+    def _batch_served(self, index, rows: int, padded: int,
+                      requests: int, wall: float) -> None:
+        r = self._picked
+        self._replica_batches[r] += 1
+        reg = telemetry.registry()
+        reg.counter_inc("serve.shard.batches")
+        reg.counter_inc("serve.shard.requests", requests)
+        reg.counter_inc("serve.shard.queries", rows)
+        reg.counter_inc(f"serve.shard.replica.{r}.batches")
+        reg.gauge_set("serve.shard.replicas", len(self.replicas))
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.SERVE_SHARD_BATCH, replica=r,
+                shards=int(getattr(index, "n_shards", 1)),
+                rows=int(rows), padded=int(padded),
+                requests=int(requests), m=int(self.m),
+                wall_s=round(wall, 6),
+            )
+
+    def stats(self) -> dict:
+        """Base coalescing tallies plus the replica routing spread."""
+        s = super().stats()
+        s["replicas"] = len(self.replicas)
+        s["replica_batches"] = list(self._replica_batches)
+        return s

@@ -164,6 +164,13 @@ class EVENTS:
     TOPK_KERNEL_SCAN_FALLBACK = "topk.kernel.scan_fallback"
     SERVE_TOPK_BATCH = "serve.topk_batch"
     SERVE_TOPK_ERROR = "serve.topk.error"
+    # sharded serving tier (ISSUE 8): per-tile shard fanout, the
+    # cross-shard candidate merge, and the replica-routed coalesced
+    # dispatch (deliberately NOT a family — rogue ``shard.*`` /
+    # ``serve.shard.*`` names stay lintable)
+    SHARD_TOPK_TILE = "shard.topk_tile"
+    SHARD_MERGE = "shard.merge"
+    SERVE_SHARD_BATCH = "serve.shard.batch"
     # durable index lifecycle (snapshot/restore + crash recovery)
     INDEX_SNAPSHOT_SAVE = "index.snapshot.save"
     INDEX_SNAPSHOT_LOAD = "index.snapshot.load"

@@ -143,6 +143,13 @@ def build_report(path: str) -> dict:
     orphan_chunks = 0
     topk_dispatches = 0
     topk_queries = 0
+    shard_tiles = 0
+    shard_fanout = 0
+    shard_merges = 0
+    shard_merge_wall = 0.0
+    shard_batches = 0
+    shard_batch_rows = 0
+    shard_replicas: set = set()
 
     for e in read_events(path):
         n_events += 1
@@ -219,6 +226,20 @@ def build_report(path: str) -> dict:
             # kernel path actually served
             topk_dispatches += 1
             topk_queries += e.get("queries", 0) or 0
+        elif name == EVENTS.SHARD_TOPK_TILE:
+            # sharded-tier fanout: one event per query tile, carrying
+            # how many shard devices the tile was dispatched across
+            shard_tiles += 1
+            shard_fanout += e.get("shards", 0) or 0
+        elif name == EVENTS.SHARD_MERGE:
+            shard_merges += 1
+            shard_merge_wall += e.get("wall_s", 0.0) or 0.0
+        elif name == EVENTS.SERVE_SHARD_BATCH:
+            # replica-routed coalesced dispatches from ShardedTopKServer
+            shard_batches += 1
+            shard_batch_rows += e.get("rows", 0) or 0
+            if e.get("replica") is not None:
+                shard_replicas.add(e["replica"])
 
     # traces whose root never ended: their buffered children are orphaned
     # work of a crashed run — count the traces as incomplete
@@ -290,8 +311,27 @@ def build_report(path: str) -> dict:
             {
                 "topk_kernel_dispatches": topk_dispatches,
                 "topk_kernel_queries": topk_queries,
+                **(
+                    {
+                        "shard_tiles": shard_tiles,
+                        "shard_dispatches": shard_fanout,
+                        "shard_merges": shard_merges,
+                        "shard_merge_wall_s": round(shard_merge_wall, 6),
+                    }
+                    if shard_tiles
+                    else {}
+                ),
+                **(
+                    {
+                        "shard_batches": shard_batches,
+                        "shard_batch_rows": shard_batch_rows,
+                        "shard_replicas_used": sorted(shard_replicas),
+                    }
+                    if shard_batches
+                    else {}
+                ),
             }
-            if topk_dispatches
+            if (topk_dispatches or shard_tiles or shard_batches)
             else None
         ),
         "degraded": degraded,
@@ -371,6 +411,20 @@ def render_report(report: dict) -> str:
             f"serving: {sv['topk_kernel_dispatches']} fused top-k kernel "
             f"dispatch(es), {sv['topk_kernel_queries']} query rows"
         )
+        if sv.get("shard_tiles"):
+            lines.append(
+                f"  sharded tier: {sv['shard_tiles']} tile(s) fanned over "
+                f"{sv['shard_dispatches']} shard dispatch(es), "
+                f"{sv['shard_merges']} cross-shard merge(s) "
+                f"({sv['shard_merge_wall_s']:.4f}s merge wall)"
+            )
+        if sv.get("shard_batches"):
+            reps = sv.get("shard_replicas_used") or []
+            lines.append(
+                f"  replica routing: {sv['shard_batches']} coalesced "
+                f"batch(es), {sv['shard_batch_rows']} rows over "
+                f"{len(reps)} replica(s)"
+            )
     lines.append("")
     lines.append("degraded-event audit:")
     worst = [(k, v) for k, v in report["degraded"].items() if v]

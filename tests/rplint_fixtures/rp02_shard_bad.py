@@ -1,0 +1,15 @@
+"""RP02 fixture (ISSUE 8 satellite): a sharded-serving path emitting a
+``shard.*`` event name that is NOT in ``telemetry.EVENTS``.  Linted
+against the REAL registry — the shard / serve.shard namespace
+deliberately has NO family prefix, so every sharded-tier event must be
+individually registered (a family would wave rogue names through)."""
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+
+def merge_with_unregistered_event(shards, candidates):
+    # VIOLATION: a sharded-tier event dodging the registry — invisible
+    # to the doctor's serving section
+    telemetry.emit("shard.rogue_merge", shards=shards, n=candidates)
+    # ok: the registered cross-shard merge event
+    telemetry.emit(EVENTS.SHARD_MERGE, shards=shards, candidates=candidates)

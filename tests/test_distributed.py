@@ -402,3 +402,37 @@ def test_host_row_range_rejects_partial_pair():
         host_row_range(100, process_count=4)
     with pytest.raises(ValueError, match="together"):
         host_row_range(100, process_id=0)
+
+
+# ---------------------------------------------------------------------------
+# sharded serving tier over a mesh's devices (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_index_spans_mesh_devices():
+    """The serving tier resolves its shard set from a jax Mesh's data
+    axis and serves bit-identically to brute force across all 8 virtual
+    devices.  Per-shard dispatch needs no shard_map, so this runs on
+    any jax — unlike the shard_map path, it is NOT mesh_env-gated."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the suite's virtual 8-device CPU topology")
+    from randomprojection_tpu.models import sketch as sk
+    from randomprojection_tpu.parallel import make_mesh
+    from randomprojection_tpu.serving import ShardedSimHashIndex
+
+    mesh = make_mesh({"data": 8})
+    rng = np.random.default_rng(42)
+    codes = rng.integers(0, 256, size=(410, 4), dtype=np.uint8)
+    queries = rng.integers(0, 256, size=(12, 4), dtype=np.uint8)
+    idx = ShardedSimHashIndex(codes, mesh=mesh, topk_impl="scan")
+    assert idx.n_shards == 8
+    assert idx.devices == list(jax.devices()[:8])
+    # every shard's chunk actually lives on its own device
+    for shard, dev in zip(idx._shards, idx.devices):
+        assert shard._chunks[0].b.devices() == {dev}
+    d, i = idx.query_topk(queries, 6)
+    rd, ri = sk.topk_bruteforce(queries, codes, 6)
+    assert np.array_equal(d, rd)
+    assert np.array_equal(i, ri.astype(np.int64))

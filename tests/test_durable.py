@@ -559,3 +559,134 @@ def test_cli_recover_requires_dir(capsys):
 
     with pytest.raises(SystemExit, match="requires DIR"):
         cli.main(["recover"])
+
+
+# ---------------------------------------------------------------------------
+# sharded snapshots (ISSUE 8): mesh-agnostic save/restore
+# ---------------------------------------------------------------------------
+
+
+def _sharded(codes, n_shards, **kw):
+    from randomprojection_tpu.serving import ShardedSimHashIndex
+
+    return ShardedSimHashIndex(
+        codes, n_shards=n_shards, topk_impl="scan", **kw
+    )
+
+
+def test_sharded_snapshot_restores_under_any_layout(tmp_path):
+    """Save under an 8-way layout, load under 4-way / 2-way / plain
+    single-device: codes, tombstones and query_topk results must be
+    bit-identical — the snapshot is the corpus in global id order, so
+    the layout is a load-time choice."""
+    from randomprojection_tpu.serving import ShardedSimHashIndex
+
+    codes = _codes(260, 4, seed=11)
+    queries = _codes(10, 4, seed=12)
+    idx = _sharded(codes, 8)
+    idx.delete(np.arange(60, 110))  # spans 8-way shard boundaries
+    ref_d, ref_i = idx.query_topk(queries, 6)
+    d = str(tmp_path / "snap")
+    manifest = idx.save(d)
+    assert manifest["sharded"] == {"shards": 8}
+    assert len(manifest["chunks"]) == 8
+    check_coverage(manifest)
+    for n_shards in (4, 2, 1):
+        r = ShardedSimHashIndex.load(d, n_shards=n_shards,
+                                     topk_impl="scan")
+        assert r.n_codes == 260 and r.n_deleted == 50
+        got_d, got_i = r.query_topk(queries, 6)
+        assert np.array_equal(got_d, ref_d), n_shards
+        assert np.array_equal(got_i, ref_i), n_shards
+    plain = load_index(d)
+    assert plain.n_codes == 260 and plain.n_deleted == 50
+    pd, pi = plain.query_topk(queries, 6)
+    assert np.array_equal(pd, ref_d)
+    assert np.array_equal(pi.astype(np.int64), ref_i)
+    status = verify_snapshot(d)
+    assert status["ok"] and status["sharded"] == 8
+    assert status["deleted"] == 50
+
+
+def test_plain_snapshot_loads_sharded(tmp_path):
+    """The reverse direction: a plain save_index snapshot restores onto
+    any shard layout with identical results."""
+    from randomprojection_tpu.serving import ShardedSimHashIndex
+
+    codes = _codes(200, 4, seed=13)
+    queries = _codes(8, 4, seed=14)
+    plain = SimHashIndex(codes, topk_impl="scan")
+    plain.delete(np.arange(25))
+    ref_d, ref_i = plain.query_topk(queries, 5)
+    d = str(tmp_path / "snap")
+    save_index(plain, d)
+    r = ShardedSimHashIndex.load(d, n_shards=3, topk_impl="scan")
+    got_d, got_i = r.query_topk(queries, 5)
+    assert np.array_equal(got_d, ref_d)
+    assert np.array_equal(got_i, ref_i.astype(np.int64))
+
+
+def test_sharded_snapshot_id_offset_round_trip(tmp_path):
+    """id_offset persists in the manifest, restores through the sharded
+    loader, and the plain loader refuses the snapshot pointedly (it
+    would silently renumber the corpus)."""
+    from randomprojection_tpu.serving import ShardedSimHashIndex
+
+    off = 2**31 + 23
+    codes = _codes(120, 4, seed=15)
+    queries = _codes(6, 4, seed=16)
+    idx = _sharded(codes, 4, id_offset=off)
+    ref_d, ref_i = idx.query_topk(queries, 4)
+    assert int(ref_i.min()) > 2**31
+    d = str(tmp_path / "snap")
+    manifest = idx.save(d)
+    assert manifest["id_offset"] == off
+    r = ShardedSimHashIndex.load(d, n_shards=2, topk_impl="scan")
+    assert r.id_offset == off
+    got_d, got_i = r.query_topk(queries, 4)
+    assert np.array_equal(got_d, ref_d)
+    assert np.array_equal(got_i, ref_i)
+    with pytest.raises(ValueError, match="id_offset"):
+        load_index(d)
+
+
+def test_sharded_snapshot_checksum_verified_before_upload(tmp_path):
+    """A corrupted shard-chunk spill fails the load loudly BEFORE any
+    upload, with the recover.checksum_mismatch event on the spine."""
+    from randomprojection_tpu.serving import ShardedSimHashIndex
+
+    codes = _codes(100, 4, seed=17)
+    idx = _sharded(codes, 4)
+    d = str(tmp_path / "snap")
+    manifest = idx.save(d)
+    victim = manifest["chunks"][2]["file"]
+    path = os.path.join(d, victim)
+    raw = np.load(path)
+    raw[0, 0] ^= 0xFF
+    with open(path, "wb") as f:
+        np.save(f, raw)
+    tel = str(tmp_path / "events.jsonl")
+    telemetry.configure(tel)
+    try:
+        with pytest.raises(ValueError, match="checksum"):
+            ShardedSimHashIndex.load(d, n_shards=2)
+    finally:
+        telemetry.shutdown()
+    names = [e["event"] for e in telemetry.read_events(tel)]
+    assert "recover.checksum_mismatch" in names
+
+
+def test_sharded_snapshot_resave_advances_generation(tmp_path):
+    """Re-saving a sharded index over its own snapshot writes a new
+    generation and sweeps the old files — same crash discipline as
+    save_index."""
+    codes = _codes(90, 4, seed=18)
+    idx = _sharded(codes, 3)
+    d = str(tmp_path / "snap")
+    m1 = idx.save(d)
+    idx.add(_codes(30, 4, seed=19))
+    m2 = idx.save(d)
+    assert m2["generation"] == m1["generation"] + 1
+    on_disk = {f for f in os.listdir(d) if f.endswith(".npy")}
+    assert on_disk == {e["file"] for e in m2["chunks"]}
+    assert check_coverage(m2) == 120

@@ -348,3 +348,26 @@ def test_registered_event_families():
     assert telemetry.registered_event("hash.batches.python")
     assert not telemetry.registered_event("hash.batch.python")
     assert not telemetry.registered_event("made.up")
+
+
+def test_rp02_unregistered_shard_event_fixture():
+    """ISSUE 8 satellite: an unregistered ``shard.*`` emit is caught
+    against the REAL shipped registry — the sharded-tier namespaces
+    (`shard.`, `serve.shard.`) have no family prefix, so each event
+    must be individually registered, and the registered merge event in
+    the same fixture stays clean."""
+    real = rplint.load_event_registry(
+        open(os.path.join(
+            rplint.package_root(), "utils", "telemetry.py"
+        )).read()
+    )
+    assert real is not None and real.knows("shard.merge")
+    assert real.knows("shard.topk_tile")
+    assert real.knows("serve.shard.batch")
+    assert not real.knows("shard.rogue_merge")
+    active, suppressed = _split(
+        _lint_fixture("rp02_shard_bad.py", registry=real)
+    )
+    assert [f.rule for f in active] == ["RP02"]
+    assert "'shard.rogue_merge'" in active[0].message
+    assert not suppressed

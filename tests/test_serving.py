@@ -1,0 +1,419 @@
+"""Sharded serving tier (ISSUE 8): ShardedSimHashIndex + ShardedTopKServer.
+
+The acceptance contract: sharded ``query_topk`` is bit-identical to
+``topk_bruteforce`` on the concatenated corpus — (distance,
+lower-global-id) order — for any shard count, including tombstones that
+span shard boundaries and a global id range past int32; snapshots
+restore under different layouts with bit-identical results (the durable
+round-trips live in tests/test_durable.py).  Most tests pin
+``topk_impl='scan'`` to keep the suite's compile bill down; the fused
+leg is covered once here and continuously by ``make shard-smoke``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu.models import sketch as sk
+from randomprojection_tpu.serving import (
+    ShardedSimHashIndex,
+    ShardedTopKServer,
+    shard_devices,
+)
+from randomprojection_tpu.utils import telemetry
+
+NB = 4  # packed bytes per code (32 bits) — tiny, compile-friendly
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    codes = rng.integers(0, 256, size=(600, NB), dtype=np.uint8)
+    queries = rng.integers(0, 256, size=(16, NB), dtype=np.uint8)
+    return codes, queries
+
+
+def _masked_ref(A, B, dead_ids, m):
+    """Brute-force reference with tombstoned columns losing every
+    comparison — the same contract the device paths implement."""
+    D = sk.pairwise_hamming(A, B).astype(np.int64)
+    if len(dead_ids):
+        D[:, dead_ids] = B.shape[1] * 8 + 1
+    return sk._host_topk_select(D, m)
+
+
+# ---------------------------------------------------------------------------
+# device resolution
+# ---------------------------------------------------------------------------
+
+
+def test_shard_devices_resolution():
+    import jax
+
+    local = jax.devices()
+    # default: one shard per local device
+    assert shard_devices() == local
+    # n_shards round-robins when it exceeds the device count
+    devs = shard_devices(n_shards=len(local) + 3)
+    assert devs[: len(local)] == local
+    assert devs[len(local)] == local[0]
+    # explicit devices win
+    assert shard_devices(devices=local[:2]) == local[:2]
+    assert shard_devices(devices=local[:2], n_shards=5) == [
+        local[0], local[1], local[0], local[1], local[0]
+    ]
+    with pytest.raises(ValueError, match="at least one"):
+        shard_devices(devices=[])
+    with pytest.raises(ValueError, match="n_shards"):
+        shard_devices(n_shards=0)
+
+
+def test_shard_devices_from_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+    devs = shard_devices(mesh=mesh)
+    assert devs == list(jax.devices()[:8])
+    # a 2-D mesh: one shard per data-axis index, first device of each slice
+    mesh2 = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                 ("data", "feature"))
+    devs2 = shard_devices(mesh=mesh2)
+    assert len(devs2) == 4
+    assert devs2 == [jax.devices()[i] for i in (0, 2, 4, 6)]
+    with pytest.raises(ValueError, match="no 'rows' axis"):
+        shard_devices(mesh=mesh, data_axis="rows")
+    # mesh fixes the layout by itself: an explicit n_shards or devices
+    # alongside it must refuse, not be silently dropped
+    with pytest.raises(ValueError, match="cannot be combined"):
+        shard_devices(mesh=mesh, n_shards=4)
+    with pytest.raises(ValueError, match="cannot be combined"):
+        shard_devices(mesh=mesh, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="cannot be combined"):
+        ShardedSimHashIndex(
+            np.zeros((16, 4), np.uint8), mesh=mesh, n_shards=4
+        )
+
+
+# ---------------------------------------------------------------------------
+# parity with brute force
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_fused_parity(corpus):
+    """The fused kernel serves PER SHARD (each shard is single-device,
+    so the r12 kernel applies where a shard_map-spanning program could
+    not) and the merged result is bit-identical to brute force."""
+    codes, queries = corpus
+    idx = ShardedSimHashIndex(codes, n_shards=3)
+    for shard in idx._shards:
+        assert shard._chunk_impl(
+            queries.shape[0], shard._chunks[0].b.shape[0],
+            min(5, shard.n_codes),
+        ) == "fused"
+    d, i = idx.query_topk(queries, 5)
+    rd, ri = sk.topk_bruteforce(queries, codes, 5)
+    assert i.dtype == np.int64
+    assert np.array_equal(d, rd) and np.array_equal(i, ri.astype(np.int64))
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 5])
+def test_sharded_scan_parity_across_layouts(corpus, n_shards):
+    codes, queries = corpus
+    idx = ShardedSimHashIndex(codes, n_shards=n_shards, topk_impl="scan")
+    d, i = idx.query_topk(queries, 7)
+    rd, ri = sk.topk_bruteforce(queries, codes, 7)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri.astype(np.int64))
+
+
+def test_sharded_tie_heavy_corpus():
+    """Few distinct codes → massed ties: the (distance, lower-global-id)
+    order must hold exactly across shard boundaries."""
+    rng = np.random.default_rng(3)
+    base = rng.integers(0, 256, size=(4, NB), dtype=np.uint8)
+    codes = base[rng.integers(0, 4, size=300)]
+    queries = base[rng.integers(0, 4, size=8)]
+    idx = ShardedSimHashIndex(codes, n_shards=4, topk_impl="scan")
+    d, i = idx.query_topk(queries, 9)
+    rd, ri = sk.topk_bruteforce(queries, codes, 9)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri.astype(np.int64))
+
+
+def test_add_keeps_insertion_order_and_balance(corpus):
+    codes, queries = corpus
+    idx = ShardedSimHashIndex(codes[:100], n_shards=4, topk_impl="scan")
+    idx.add(codes[100:350])
+    idx.add(codes[350:])
+    assert idx.n_codes == 600
+    sizes = idx.stats()["shard_rows"]
+    assert max(sizes) - min(sizes) <= 1, sizes
+    d, i = idx.query_topk(queries, 6)
+    rd, ri = sk.topk_bruteforce(queries, codes, 6)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri.astype(np.int64))
+
+
+def test_empty_shards_are_skipped(corpus):
+    _, queries = corpus
+    rng = np.random.default_rng(5)
+    tiny = rng.integers(0, 256, size=(5, NB), dtype=np.uint8)
+    idx = ShardedSimHashIndex(tiny, n_shards=8, topk_impl="scan")
+    assert sorted(idx.stats()["shard_rows"], reverse=True)[:5] == [1] * 5
+    d, i = idx.query_topk(queries, 3)
+    rd, ri = sk.topk_bruteforce(queries, tiny, 3)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri.astype(np.int64))
+
+
+# ---------------------------------------------------------------------------
+# tombstones across shard boundaries
+# ---------------------------------------------------------------------------
+
+
+def test_tombstones_span_shard_boundaries(corpus):
+    codes, queries = corpus
+    idx = ShardedSimHashIndex(codes, n_shards=4, topk_impl="scan")
+    # 4 shards of 150 rows: [120, 330) crosses two shard boundaries
+    dead = np.arange(120, 330)
+    assert idx.delete(dead) == 210
+    assert idx.delete(dead) == 0  # idempotent
+    assert idx.n_deleted == 210 and idx.n_live == 390
+    d, i = idx.query_topk(queries, 8)
+    rd, ri = _masked_ref(queries, codes, dead, 8)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri.astype(np.int64))
+
+
+def test_delete_validation(corpus):
+    codes, _ = corpus
+    idx = ShardedSimHashIndex(codes, n_shards=2, topk_impl="scan")
+    with pytest.raises(ValueError, match="integers"):
+        idx.delete(np.array([1.5]))
+    with pytest.raises(ValueError, match=r"\[0, 600\)"):
+        idx.delete([600])
+    assert idx.delete([]) == 0
+
+
+def test_m_clamps_to_live_and_error_paths(corpus):
+    codes, queries = corpus
+    small = codes[:40]
+    idx = ShardedSimHashIndex(small, n_shards=3, topk_impl="scan")
+    idx.delete(np.arange(30, 40))
+    d, i = idx.query_topk(queries, 64)  # m > n_live clamps
+    assert d.shape == (16, 30) and i.shape == (16, 30)
+    rd, ri = _masked_ref(queries, small, np.arange(30, 40), 30)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri.astype(np.int64))
+    idx.delete(np.arange(30))
+    with pytest.raises(ValueError, match="all deleted"):
+        idx.query_topk(queries, 3)
+    empty = ShardedSimHashIndex(
+        np.empty((0, NB), np.uint8), n_shards=2, topk_impl="scan"
+    )
+    with pytest.raises(ValueError, match="empty index"):
+        empty.query_topk(queries, 3)
+    with pytest.raises(ValueError, match="positive int"):
+        idx.query_topk(queries, 0)
+    with pytest.raises(ValueError, match="queries must be"):
+        idx.query_topk(np.zeros((2, NB + 1), np.uint8), 3)
+
+
+# ---------------------------------------------------------------------------
+# global-int64 id space
+# ---------------------------------------------------------------------------
+
+
+def test_id_offset_past_int32(corpus):
+    """The int64 global id space, proven without a 2-billion-row
+    fixture: with id_offset past 2^31 every returned id exceeds int32
+    and the merge order still matches brute force exactly."""
+    codes, queries = corpus
+    off = 2**31 + 19
+    idx = ShardedSimHashIndex(
+        codes, n_shards=4, topk_impl="scan", id_offset=off
+    )
+    d, i = idx.query_topk(queries, 7)
+    rd, ri = sk.topk_bruteforce(queries, codes, 7)
+    assert np.array_equal(d, rd)
+    assert np.array_equal(i, ri.astype(np.int64) + off)
+    assert int(i.min()) > 2**31
+    # delete speaks offset ids too — and validates in offset space
+    assert idx.delete(np.array([off, off + 1])) == 2
+    with pytest.raises(ValueError, match=str(off + 600)):
+        idx.delete([off + 600])
+    d2, i2 = idx.query_topk(queries, 7)
+    rd2, ri2 = _masked_ref(queries, codes, np.array([0, 1]), 7)
+    assert np.array_equal(d2, rd2)
+    assert np.array_equal(i2, ri2.astype(np.int64) + off)
+
+
+def test_per_shard_capacity_error_names_shard():
+    """The 2^31-1 refusal is now a per-shard invariant with a pointed
+    error naming the shard and the int64 growth path."""
+    codes = np.random.default_rng(0).integers(
+        0, 256, size=(16, NB), dtype=np.uint8
+    )
+    shard = sk.SimHashIndex(codes, label="shard 3/8 on FakeDevice(3)")
+    shard.n_codes = 2**31 - 10  # simulate a near-capacity shard
+    with pytest.raises(ValueError) as ei:
+        shard.add(codes)
+    msg = str(ei.value)
+    assert "shard 3/8 on FakeDevice(3)" in msg
+    assert "ShardedSimHashIndex" in msg and "int64" in msg
+
+
+# ---------------------------------------------------------------------------
+# dense analysis surface + compaction
+# ---------------------------------------------------------------------------
+
+
+def test_dense_query_global_column_order(corpus):
+    codes, queries = corpus
+    idx = ShardedSimHashIndex(codes, n_shards=3, topk_impl="scan")
+    idx.add(codes[:50])  # second segment per shard
+    full = np.concatenate([codes, codes[:50]])
+    assert np.array_equal(idx.query(queries), sk.pairwise_hamming(
+        queries, full
+    ))
+    est = idx.query_cosine(queries)
+    assert est.shape == (16, 650)
+
+
+def test_compact_folds_and_remaps(corpus):
+    codes, queries = corpus
+    idx = ShardedSimHashIndex(codes, n_shards=3, topk_impl="scan")
+    dead = np.arange(100, 260)
+    idx.delete(dead)
+    mapping = idx.compact()
+    live = np.delete(np.arange(600), dead)
+    assert np.array_equal(mapping, live)
+    assert idx.n_deleted == 0 and idx.n_codes == 440
+    assert all(len(s._chunks) <= 1 for s in idx._shards)
+    d, i = idx.query_topk(queries, 6)
+    rd, ri = sk.topk_bruteforce(queries, codes[live], 6)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri.astype(np.int64))
+
+
+# ---------------------------------------------------------------------------
+# replica-aware server
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_server_round_robin_bit_identical(corpus):
+    codes, queries = corpus
+    r1 = ShardedSimHashIndex(codes, n_shards=2, topk_impl="scan")
+    r2 = ShardedSimHashIndex(codes, n_shards=3, topk_impl="scan")
+    rd, ri = sk.topk_bruteforce(queries, codes, 5)
+    with ShardedTopKServer([r1, r2], 5, max_delay_s=0.0) as srv:
+        assert srv.n_replicas == 2
+        # max_delay_s=0 -> one dispatch per request -> strict round-robin
+        for k in range(4):
+            d, i = srv.query(queries[k * 4 : (k + 1) * 4])
+            assert np.array_equal(d, rd[k * 4 : (k + 1) * 4])
+            assert np.array_equal(i, ri.astype(np.int64)[k * 4 : (k + 1) * 4])
+        stats = srv.stats()
+    assert stats["replicas"] == 2
+    assert stats["replica_batches"] == [2, 2]
+    assert stats["requests"] == 4
+
+
+def test_sharded_server_validates_replicas(corpus):
+    codes, _ = corpus
+    r1 = ShardedSimHashIndex(codes, n_shards=2, topk_impl="scan")
+    r2 = ShardedSimHashIndex(codes[:500], n_shards=2, topk_impl="scan")
+    with pytest.raises(ValueError, match="replica 1 disagrees"):
+        ShardedTopKServer([r1, r2], 5, start=False)
+    # same n_bytes but a different ragged bit width changes distances,
+    # so it must refuse too — results would be routing-dependent
+    r3 = ShardedSimHashIndex(
+        codes, n_shards=2, n_bits=codes.shape[1] * 8 - 3, topk_impl="scan"
+    )
+    with pytest.raises(ValueError, match="n_bits"):
+        ShardedTopKServer([r1, r3], 5, start=False)
+    with pytest.raises(ValueError, match="at least one replica"):
+        ShardedTopKServer([], 5, start=False)
+
+
+def test_plain_topk_server_accepts_sharded_index(corpus):
+    """The base micro-batcher needs only the query_topk surface, so a
+    sharded index drops in without the replica layer."""
+    codes, queries = corpus
+    idx = ShardedSimHashIndex(codes, n_shards=2, topk_impl="scan")
+    with sk.TopKServer(idx, 4, max_delay_s=0.0) as srv:
+        d, i = srv.query(queries)
+    rd, ri = sk.topk_bruteforce(queries, codes, 4)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri.astype(np.int64))
+
+
+# ---------------------------------------------------------------------------
+# telemetry: shard events feed the doctor's serving section
+# ---------------------------------------------------------------------------
+
+
+def test_shard_events_and_doctor_serving_section(tmp_path, corpus):
+    from randomprojection_tpu.utils.trace_report import (
+        build_report,
+        render_report,
+    )
+
+    codes, queries = corpus
+    tel = str(tmp_path / "events.jsonl")
+    idx = ShardedSimHashIndex(codes, n_shards=2, topk_impl="scan")
+    telemetry.configure(tel)
+    try:
+        with ShardedTopKServer(idx, 5, max_delay_s=0.0) as srv:
+            srv.query(queries)
+        idx.query_topk(queries, 5)
+    finally:
+        telemetry.shutdown()
+    names = [e["event"] for e in telemetry.read_events(tel)]
+    assert "shard.topk_tile" in names
+    assert "shard.merge" in names
+    assert "serve.shard.batch" in names
+    report = build_report(tel)
+    sv = report["serving"]
+    assert sv["shard_tiles"] >= 2
+    assert sv["shard_dispatches"] == 2 * sv["shard_tiles"]
+    assert sv["shard_merges"] == sv["shard_tiles"]
+    assert sv["shard_batches"] == 1
+    assert sv["shard_replicas_used"] == [0]
+    assert report["unregistered_events"] == {}
+    rendered = render_report(report)
+    assert "sharded tier:" in rendered and "replica routing:" in rendered
+    # counters on the default registry
+    assert telemetry.registry().counter("serve.shard.batches") >= 1
+    assert telemetry.registry().counter("shard.dispatches") >= 2
+
+
+def test_sharded_index_stats(corpus):
+    codes, queries = corpus
+    idx = ShardedSimHashIndex(codes, n_shards=2, topk_impl="scan")
+    idx.query_topk(queries, 3)
+    s = idx.stats()
+    assert s["shards"] == 2 and s["merges"] >= 1
+    assert s["merge_wall_s"] >= 0.0
+    assert sum(s["shard_rows"]) == 600
+
+
+# ---------------------------------------------------------------------------
+# construction validation
+# ---------------------------------------------------------------------------
+
+
+def test_construction_validation():
+    with pytest.raises(ValueError, match="codes must be"):
+        ShardedSimHashIndex(np.zeros((2, 2, 2), np.uint8), n_shards=2)
+    with pytest.raises(ValueError, match="id_offset"):
+        ShardedSimHashIndex(
+            np.zeros((2, NB), np.uint8), n_shards=2, id_offset=-1
+        )
+    with pytest.raises(ValueError, match="n_bits"):
+        ShardedSimHashIndex(
+            np.zeros((2, NB), np.uint8), n_shards=2, n_bits=NB * 8 + 1
+        )
+    with pytest.raises(ValueError, match="device= pins"):
+        import jax
+
+        sk.SimHashIndex(
+            np.zeros((2, NB), np.uint8), device=jax.devices()[0],
+            mesh=object(),
+        )
