@@ -15,6 +15,14 @@
 #                      a toy index, parity-asserted against the scan
 #                      path and host brute force — run before tier-1 so
 #                      a broken serving kernel fails fast
+#   make transform-smoke  interpreter-mode fused transform kernel
+#                      (ISSUE 9): the double-buffered x DMA route ==
+#                      the single-buffered tiling == the numpy
+#                      contraction of the matching materialized matrix
+#                      on a toy ragged shape, and the multi-step
+#                      dispatch chain == separate dispatches — run
+#                      before tier-1 so a broken transform route fails
+#                      fast
 #   make shard-smoke   sharded serving tier (ISSUE 8) on the virtual
 #                      8-device CPU mesh: fused-per-shard == scan ==
 #                      brute force, cross-shard tombstones and >int32
@@ -32,9 +40,11 @@ SHELL := /bin/bash
 PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
-.PHONY: verify lint tier1 kernel-smoke shard-smoke recover-smoke doctor-smoke
+.PHONY: verify lint tier1 kernel-smoke transform-smoke shard-smoke \
+        recover-smoke doctor-smoke
 
-verify: lint kernel-smoke shard-smoke recover-smoke tier1 doctor-smoke
+verify: lint kernel-smoke transform-smoke shard-smoke recover-smoke tier1 \
+        doctor-smoke
 
 lint:
 	$(PYTHON) -m randomprojection_tpu lint
@@ -54,6 +64,24 @@ kernel-smoke:
 	ds, js = scan.query_topk(A, 7); \
 	assert (ds == rd).all() and (js == ri).all(), 'scan/brute mismatch'; \
 	print('kernel-smoke OK: fused (interpret) == scan == brute force')"
+
+transform-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import numpy as np; \
+	import jax.numpy as jnp; \
+	from randomprojection_tpu.ops import pallas_kernels as pk; \
+	assert pk._DMA_DEFAULT, 'DMA not the default transform route'; \
+	x = np.random.default_rng(0).normal(size=(70, 700)).astype(np.float32); \
+	xj = jnp.asarray(x); \
+	yd = np.asarray(pk.fused_sparse_project(xj, 7, 16, 0.25, interpret=True, dma=True)); \
+	ys = np.asarray(pk.fused_sparse_project(xj, 7, 16, 0.25, interpret=True, dma=False)); \
+	assert (yd == ys).all(), 'DMA / single-buffered mismatch'; \
+	R = np.asarray(pk.pallas_sparse_matrix(7, 16, 700, 0.25, interpret=True)); \
+	np.testing.assert_allclose(yd, x @ R.T, rtol=1e-4, atol=1e-4); \
+	ym = np.asarray(pk.fused_project_multistep(xj, 7, 16, 0.25, steps=3, interpret=True)); \
+	per = -(-70 // 3); \
+	parts = [np.asarray(pk.fused_sparse_project(xj[lo:lo+per], 7, 16, 0.25, interpret=True)) for lo in range(0, 70, per)]; \
+	assert (ym == np.concatenate(parts)).all(), 'multistep / separate-dispatch mismatch'; \
+	print('transform-smoke OK: dma == single-buffered == numpy ref; multistep == K dispatches')"
 
 shard-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \

@@ -48,6 +48,13 @@ Method
 - On THIS box the believable numbers are dominated by ~133 ms/dispatch
   virtualization overhead and are lower bounds on chip throughput — see
   BASELINE.md "What this box's believable numbers actually measure".
+- Since r14 the record (and the compact digest) carries the execution-knob
+  provenance of the lazy modes: ``transform_dma`` ("auto" = the kernel's
+  default manual double-buffered x DMA route; "single" = the pre-r14
+  automatic tiling, the A/B lever) and ``dispatch_steps`` (anti-cache
+  steps chained through one traced dispatch — call-boundary host gaps
+  amortize by 1/steps).  ``cli bench --transform-dma/--dispatch-steps``
+  sets them; this wrapper runs the defaults.
 
 Implementation lives in ``randomprojection_tpu/benchmark.py`` (presets,
 reusable from the CLI); this wrapper keeps the driver's entry point stable.

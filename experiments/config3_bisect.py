@@ -1,0 +1,199 @@
+"""Config-3 regression bisect (ROADMAP #3 sub-item; ISSUE 9 satellite).
+
+The decay: config-3 (very-sparse Li 16384→512, lazy_split2) went
+3.30M rows/s in `BENCH_r04.json` to 2.88M in `BENCH_r05.json` — −13% —
+with BIT-IDENTICAL checksum and distortion (same kernel, same values),
+so the regression is pure wall-clock: +11.6 ms per timed call
+(0.0795 → 0.0910 s/call at 16 steps × 16384 rows/call).  Three suspects
+were named in VERDICT r5 and never separated:
+
+- **mask machinery** — the r5 round added the VMEM mask-cache sizing;
+  if cache setup/regen slots cost wall at this shape, disabling the
+  cache (and, since r14, switching the DMA route) moves the rate.
+- **block shape** — `_auto_block_n` resolves the row tile per shape;
+  if r5's sizing picked a different tile, pinning `block_n` moves it.
+- **dispatch count** — config-3 runs only 16 steps/call, so per-call
+  host overhead (~100-133 ms dispatch latency on this virtualized box,
+  observed to wander round-to-round) is a large share of elapsed; if
+  the decay is call-boundary, the rate recovers as steps/call grows
+  and the per-call overhead intercept — not the steady-state rate —
+  is what moved.
+
+This script isolates the three at the exact config-3 shape by sweeping
+ONE lever at a time through `benchmark.measure_config3` (the committed
+methodology — same `_scan_harness`, same anti-cache defenses):
+
+- route sweep:  {dma+cache, single+cache, dma+nocache}        (A)
+- tile sweep:   block_n ∈ {auto, 256, 512, 1024}              (B)
+- steps sweep:  steps ∈ {4, 8, 16, 64, 256}, then a least-squares fit
+  of ``elapsed = calls·overhead + rows·per_row`` — the intercept is
+  the per-call host overhead, the slope the steady-state rate.   (C)
+
+Reading the output: the lever whose sweep reproduces a ≥13% swing is
+the cause.  If (C)'s fitted overhead is ≥11 ms/call while (A) and (B)
+are flat, the r5 decay was call-boundary/box variance and the recovery
+lever is dispatch fusion (more steps chained per traced dispatch —
+exactly the r14 ``dispatch_steps`` knob); BASELINE.md records the
+verdict.
+
+TPU required for real numbers (the lazy kernel's hardware PRNG has no
+CPU lowering); ``--smoke`` runs the SAME three sweeps with the same
+harness at a toy shape under the Pallas interpreter, so the bisect
+plumbing is CI-provable off-chip (rates meaningless there).
+
+Run: python experiments/config3_bisect.py [--smoke] [--json PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _fit_overhead(samples):
+    """Least squares for elapsed = calls*overhead + rows*per_row over
+    [(rows_timed, calls, elapsed_s)] samples."""
+    A = np.array([[c, r] for r, c, _ in samples], dtype=np.float64)
+    b = np.array([e for _, _, e in samples])
+    (overhead, per_row), *_ = np.linalg.lstsq(A, b, rcond=None)
+    return float(overhead), float(per_row)
+
+
+def _smoke_measure(dma=None, steps=None, block_n=None, no_cache=False):
+    """Toy-shape stand-in for ``measure_config3`` under the interpreter:
+    identical sweep surface and harness, CPU-feasible shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from randomprojection_tpu import benchmark as bm
+    from randomprojection_tpu.ops.pallas_kernels import fused_sparse_project
+
+    batch, d, k, calls = 64, 1024, 16, 2
+    steps = 2 if steps is None else min(int(steps), 4)
+
+    def project(x):
+        return fused_sparse_project(
+            x, 0, k, 1.0 / 32, mxu_mode="split2", dma=dma, block_n=block_n,
+            no_cache=no_cache, interpret=True,
+        )
+
+    x0 = jax.random.normal(jax.random.key(3), (batch, d), jnp.float32)
+    rate, elapsed, _ = bm._scan_harness(jax, jnp, project, x0, steps, calls)
+    return {"rows_per_s": round(rate, 1), "elapsed_s": round(elapsed, 4),
+            "rows_timed": batch * steps * calls}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="toy shape under the Pallas interpreter (CPU): "
+                         "proves the bisect plumbing, not the rates")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the full sweep record here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm")
+    if not on_tpu and not args.smoke:
+        print("config3_bisect: no TPU attached (lazy kernel has no CPU "
+              "lowering) — re-run on a chip, or --smoke for the "
+              "interpreter plumbing check", file=sys.stderr)
+        return 2
+
+    if args.smoke:
+        measure = _smoke_measure
+        rows_per_call_steps = 64  # batch rows at the toy shape
+        steps_grid = [1, 2, 4]
+    else:
+        from randomprojection_tpu import benchmark as bm
+
+        def measure(**kw):
+            return bm.measure_config3("full", **kw)
+
+        rows_per_call_steps = 16384
+        steps_grid = [4, 8, 16, 64, 256]
+
+    record = {"on_tpu": on_tpu, "smoke": args.smoke, "sweeps": {}}
+
+    # (A) route sweep: mask machinery / DMA routing, everything else fixed
+    route = {}
+    for label, kw in [
+        ("dma+cache", dict()),
+        ("single+cache", dict(dma=False)),
+        ("dma+nocache", dict(no_cache=True)),
+    ]:
+        r = measure(**kw)
+        route[label] = {"rows_per_s": r["rows_per_s"],
+                        "elapsed_s": r["elapsed_s"]}
+        print(f"A route   {label:<14} {r['rows_per_s']:>12,.0f} rows/s "
+              f"({r['elapsed_s']:.4f}s)")
+    record["sweeps"]["route"] = route
+
+    # (B) tile sweep: block shape at the default route
+    tile = {}
+    for bn in (None, 256, 512, 1024):
+        label = "auto" if bn is None else str(bn)
+        try:
+            r = measure(block_n=bn)
+        except Exception as e:  # a pinned tile can legitimately blow VMEM
+            tile[label] = {"error": str(e)[:120]}
+            print(f"B tile    {label:<14} failed: {str(e)[:60]}")
+            continue
+        tile[label] = {"rows_per_s": r["rows_per_s"],
+                       "elapsed_s": r["elapsed_s"]}
+        print(f"B tile    {label:<14} {r['rows_per_s']:>12,.0f} rows/s "
+              f"({r['elapsed_s']:.4f}s)")
+    record["sweeps"]["tile"] = tile
+
+    # (C) dispatch-count sweep: vary steps/call, fit per-call overhead
+    # (intercept) against steady-state rate (slope)
+    samples = []
+    steps_sweep = {}
+    for s in steps_grid:
+        r = measure(steps=s)
+        ran_calls = r["rows_timed"] // (rows_per_call_steps * s)
+        samples.append((r["rows_timed"], ran_calls, r["elapsed_s"]))
+        steps_sweep[str(s)] = {"rows_per_s": r["rows_per_s"],
+                               "elapsed_s": r["elapsed_s"],
+                               "calls": ran_calls}
+        print(f"C steps   {s:<14} {r['rows_per_s']:>12,.0f} rows/s "
+              f"({ran_calls} calls, {r['elapsed_s']:.4f}s)")
+    overhead_s, per_row_s = _fit_overhead(samples)
+    asymptote = 1.0 / per_row_s if per_row_s > 0 else float("nan")
+    record["sweeps"]["steps"] = steps_sweep
+    record["fit"] = {
+        "per_call_overhead_s": round(overhead_s, 5),
+        "steady_state_rows_per_s": round(asymptote, 1),
+    }
+    print(f"C fit     per-call overhead {overhead_s * 1e3:.1f} ms, "
+          f"steady-state {asymptote:,.0f} rows/s")
+
+    # verdict heuristic: which lever moved >= 10%?
+    def spread(d):
+        rs = [v["rows_per_s"] for v in d.values() if "rows_per_s" in v]
+        return (max(rs) - min(rs)) / max(rs) if rs else 0.0
+
+    verdict = {
+        "route_spread": round(spread(route), 3),
+        "tile_spread": round(spread(tile), 3),
+        "fitted_overhead_ms_per_call": round(overhead_s * 1e3, 2),
+        "r5_decay_ms_per_call": 11.6,
+    }
+    if not on_tpu:
+        verdict["note"] = ("interpreter smoke — plumbing only, rates "
+                           "meaningless; run on TPU for the verdict")
+    record["verdict"] = verdict
+    print("verdict:", json.dumps(verdict))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,7 +62,13 @@ def analyze(trace_dir: str) -> None:
         agg[name][0] += 1
         agg[name][1] += e.duration_ps / 1e12
     whiles = {n: v for n, v in agg.items() if n.startswith("%while")}
-    kernel = {n: v for n, v in agg.items() if "_fused_impl" in n}
+    # the jitted transform kernel is named after its raw body (_fused_raw
+    # since r14; _fused_impl in pre-r14 profiles) — match both so old
+    # captures keep decomposing
+    kernel = {
+        n: v for n, v in agg.items()
+        if "_fused_raw" in n or "_fused_impl" in n
+    }
     w_total = sum(v[1] for v in whiles.values())
     # the kernel can appear under several event names (custom-call plus
     # async wrappers); the STEP count is the count of any single name

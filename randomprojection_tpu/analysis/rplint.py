@@ -117,6 +117,12 @@ PIPELINE_MODULES = HOT_MODULES + (
     "ops/hashing.py",
     "utils/observability.py",
     TELEMETRY_MODULE,
+    # r14: the bench harness carries the transform-route/dispatch-fusion
+    # knobs whose provenance the tripwire depends on, and the doctor is
+    # the consumer of the kernel.dma.* route records — a swallowed error
+    # in either silently falsifies a measurement
+    "benchmark.py",
+    TRACE_REPORT_MODULE,
 )
 DETERMINISM_PREFIXES = ("ops/",)
 # RP05: Generator-construction surface of np.random that stays legal

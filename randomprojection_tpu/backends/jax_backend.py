@@ -94,6 +94,8 @@ class JaxBackend(ProjectionBackend):
         data_axis: str = "data",
         feature_axis: Optional[str] = None,
         materialization: str = "dense",
+        dispatch_steps: int = 1,
+        transform_dma: Optional[bool] = None,
     ):
         import jax  # deferred: `backend='numpy'` must never import jax
 
@@ -117,6 +119,48 @@ class JaxBackend(ProjectionBackend):
                 f"materialization must be 'dense' or 'lazy', got {materialization!r}"
             )
         self.materialization = materialization
+        # ISSUE 9 execution knobs — deliberately backend options, NOT
+        # ProjectionSpec fields: the spec defines the matrix (and thus the
+        # persisted-model format); DMA routing and dispatch fusion change
+        # how a transform executes, never what it computes.
+        if int(dispatch_steps) < 1:
+            raise ValueError(
+                f"dispatch_steps must be >= 1, got {dispatch_steps}"
+            )
+        #: chain this many row-blocks of each lazy transform through ONE
+        #: traced dispatch (call-boundary host gaps amortize by 1/K);
+        #: 1 = one kernel dispatch per call (the pre-r14 behavior)
+        self.dispatch_steps = int(dispatch_steps)
+        #: fused-kernel x routing: None = the kernel default (manual
+        #: double-buffered DMA), False pins the single-buffered tiling
+        if transform_dma not in (None, True, False):
+            raise ValueError(
+                "transform_dma must be None (kernel default), True or "
+                f"False, got {transform_dma!r}"
+            )
+        self.transform_dma = transform_dma
+        # the knobs only steer the fused lazy kernel's single-device route
+        # — warn (don't raise: CLI wiring sets them unconditionally) when
+        # this backend's configuration routes around them, so a bench run
+        # can't silently measure a route it never took
+        if self.materialization != "lazy" and (
+            self.dispatch_steps > 1 or self.transform_dma is not None
+        ):
+            from randomprojection_tpu.utils.observability import logger
+
+            logger.warning(
+                "dispatch_steps/transform_dma affect only the fused lazy "
+                "transform kernel; materialization=%r ignores them",
+                self.materialization,
+            )
+        elif self.mesh is not None and self.dispatch_steps > 1:
+            from randomprojection_tpu.utils.observability import logger
+
+            logger.warning(
+                "dispatch_steps is ignored on the mesh path (the shard_map "
+                "program dispatches per shard; only the single-device lazy "
+                "route chains row-blocks through one dispatch)"
+            )
         self._transform_fn = None
         self._inverse_fn = None
         self._sign_fn = None
@@ -208,8 +252,11 @@ class JaxBackend(ProjectionBackend):
                     )
             if jax.default_backend() in ("cpu", "gpu", "cuda", "rocm"):
                 # the mask is defined by the TPU hardware PRNG (pltpu.prng_*):
-                # no CPU/GPU emulation — the interpreter returns zero bits,
-                # which would silently produce a zero matrix — refuse instead
+                # the interpreter's substitute stream (_interp_mask_block) has
+                # the right distribution but a DIFFERENT stream per seed, so a
+                # CPU-built projection would silently mismatch the persisted
+                # TPU matrix — refuse instead (tests drive interpret=True
+                # explicitly at the kernel layer)
                 raise RuntimeError(
                     "materialization='lazy' requires a TPU backend (the "
                     "in-kernel PRNG has no CPU/GPU emulation); use the default "
@@ -463,7 +510,7 @@ class JaxBackend(ProjectionBackend):
         return "f32" if self.precision == "default" else "split2"
 
     def _get_lazy_mesh_fn(self, state, spec: ProjectionSpec, mxu_mode: str,
-                          no_cache: bool = False):
+                          no_cache: bool = False, dma: Optional[bool] = None):
         """shard_map'd fused lazy projection over the mesh.
 
         DP: each device runs the fused kernel on its row shard — the matrix
@@ -476,7 +523,8 @@ class JaxBackend(ProjectionBackend):
         the dense TP path, still no R in HBM anywhere.
         """
         cache_key = (
-            state.seed, state.density, spec.n_components, mxu_mode, no_cache
+            state.seed, state.density, spec.n_components, mxu_mode, no_cache,
+            dma,
         )
         fn = self._lazy_mesh_fns.get(cache_key)
         if fn is not None:
@@ -500,7 +548,7 @@ class JaxBackend(ProjectionBackend):
                 # row tile for this shard's row count
                 return fused_sparse_project(
                     x, seed, k, density, mxu_mode=mxu_mode,
-                    no_cache=no_cache,
+                    no_cache=no_cache, dma=dma,
                 )
 
         else:
@@ -515,6 +563,7 @@ class JaxBackend(ProjectionBackend):
                     block_offset=offset,
                     mxu_mode=mxu_mode,
                     no_cache=no_cache,
+                    dma=dma,
                 )
                 return jax.lax.psum(partial, feature_axis)
 
@@ -561,9 +610,14 @@ class JaxBackend(ProjectionBackend):
                 **telemetry.trace_fields(),
             )
         with annotate("rp:backend/project"):
-            return self._project_prepared(x, n, state, spec), device_resident
+            # donate only buffers this backend created (host uploads):
+            # a user's device-resident input must survive the call
+            return self._project_prepared(
+                x, n, state, spec, donate=not device_resident
+            ), device_resident
 
-    def _project_prepared(self, x, n, state, spec: ProjectionSpec):
+    def _project_prepared(self, x, n, state, spec: ProjectionSpec, *,
+                          donate: bool = False):
         if isinstance(state, _SplitMask):
             y = self._get_split_fn()(
                 x.astype(self._jax.numpy.float32), state.mask, state.scale
@@ -579,72 +633,105 @@ class JaxBackend(ProjectionBackend):
             else:
                 mxu_mode, xc = self._lazy_mxu_mode(), x.astype(jnp.float32)
             if self.mesh is not None:
-                # per-SHAPE memo of scoped-VMEM compile failures: jit
+                # per-SHAPE memos of scoped-VMEM compile failures: jit
                 # compiles the (shape-agnostic) mesh fn per input shape, so
                 # one exotic batch shape blowing VMEM must route only ITS
-                # shape to the degraded no-cache variant — healthy shapes
-                # keep the cached-mask kernel (same shape granularity as
-                # pallas_kernels._NO_CACHE_KEYS)
+                # shape to a degraded variant — healthy shapes keep the
+                # DMA + cached-mask kernel (same shape granularity as
+                # pallas_kernels._NO_DMA_KEYS/_NO_CACHE_KEYS).  The
+                # shard_map compiles outside fused_sparse_project's own
+                # eager fallback frame, so the ladder — DMA off first
+                # (single-buffered tiling), then the mask cache off
+                # (regenerate-every-step) — lives at this call site.
                 oom_shapes = self.__dict__.setdefault(
                     "_lazy_oom_shapes", set()
+                )
+                dma_off_shapes = self.__dict__.setdefault(
+                    "_lazy_dma_off_shapes", set()
                 )
                 shape_key = (
                     state.seed, state.density, spec.n_components, mxu_mode,
                     tuple(xc.shape),
                 )
-                try:
-                    y = self._get_lazy_mesh_fn(
-                        state, spec, mxu_mode,
-                        no_cache=shape_key in oom_shapes,
-                    )(xc)
-                except Exception as e:  # pragma: no cover — Mosaic VMEM OOM
-                    # the shard_map compiles outside fused_sparse_project's
-                    # own eager fallback frame, so the scoped-VMEM retry
-                    # (cache disabled = the documented regenerate-every-step
-                    # degeneration) lives at this call site
-                    from randomprojection_tpu.ops.pallas_kernels import (
-                        is_vmem_oom,
-                    )
+                from randomprojection_tpu.ops.pallas_kernels import (
+                    _vmem_ladder,
+                )
 
-                    if not is_vmem_oom(e):
-                        raise
-                    from randomprojection_tpu.ops.pallas_kernels import (
-                        record_vmem_oom_retry,
-                    )
-                    from randomprojection_tpu.utils.observability import (
-                        logger,
-                    )
+                dma_opt = (
+                    False if shape_key in dma_off_shapes
+                    else self.transform_dma
+                )
 
-                    logger.warning(
-                        "fused lazy kernel hit a scoped-VMEM limit for "
-                        "shape %s; retrying without the in-VMEM mask cache "
-                        "(regenerate-every-step degradation)", shape_key,
-                    )
-                    record_vmem_oom_retry(
-                        xc.shape, mxu_mode, spec.n_components
-                    )
-                    y = self._get_lazy_mesh_fn(
-                        state, spec, mxu_mode, no_cache=True
+                def _mesh_call(a_dma, a_nc):
+                    return self._get_lazy_mesh_fn(
+                        state, spec, mxu_mode, no_cache=a_nc, dma=a_dma,
                     )(xc)
-                    # memoize only now that the degraded retry actually
-                    # compiled: a misclassified failure must not pin this
-                    # shape to the slow path for the process lifetime
-                    oom_shapes.add(shape_key)
+
+                # traced=True: these dispatches are already counted by
+                # backend.dispatch — the eager route event would double-count
+                y = _vmem_ladder(
+                    _mesh_call, shape_key, dma_opt,
+                    shape_key not in oom_shapes, xc.shape, mxu_mode,
+                    spec.n_components, traced=True,
+                    no_dma_keys=dma_off_shapes, no_cache_keys=oom_shapes,
+                    label="fused lazy kernel",
+                )
                 y = y.astype(x.dtype)
             else:
                 from randomprojection_tpu.ops.pallas_kernels import (
+                    fused_project_multistep,
                     fused_sparse_project,
+                    multistep_chain_length,
                 )
 
                 # block_n=None: the kernel's shape-aware auto tile (largest
                 # VMEM-fitting row tile, no re-padding of small batches)
-                y = fused_sparse_project(
-                    xc,
-                    state.seed,
-                    spec.n_components,
-                    state.density,
-                    mxu_mode=mxu_mode,
-                ).astype(x.dtype)
+                if self.dispatch_steps > 1 and xc.shape[0] > 1:
+                    # multi-step dispatch fusion (ISSUE 9): chain K
+                    # row-blocks through ONE traced dispatch; donate only
+                    # when the input arrived as a host array (the upload
+                    # inside the jit is then a buffer nothing else
+                    # references).  A device-resident input is never
+                    # donated — including prepare_batch uploads: their
+                    # provenance isn't tracked through the prefetch
+                    # queue, so they are conservatively treated as
+                    # user-owned and survive the call
+                    y = fused_project_multistep(
+                        xc,
+                        state.seed,
+                        spec.n_components,
+                        state.density,
+                        steps=self.dispatch_steps,
+                        mxu_mode=mxu_mode,
+                        dma=self.transform_dma,
+                        donate=donate,
+                    )
+                    from randomprojection_tpu.utils import telemetry
+
+                    if telemetry.enabled():
+                        telemetry.emit(
+                            telemetry.EVENTS.BACKEND_DISPATCH_FUSED,
+                            rows=int(xc.shape[0]),
+                            # launches actually chained, not the knob:
+                            # the clamp + ceil-split can round below
+                            # dispatch_steps on small batches
+                            steps=multistep_chain_length(
+                                xc.shape[0], self.dispatch_steps
+                            ),
+                            n_components=spec.n_components,
+                            donated=bool(donate),
+                            **telemetry.trace_fields(),
+                        )
+                    y = y.astype(x.dtype)
+                else:
+                    y = fused_sparse_project(
+                        xc,
+                        state.seed,
+                        spec.n_components,
+                        state.density,
+                        mxu_mode=mxu_mode,
+                        dma=self.transform_dma,
+                    ).astype(x.dtype)
         else:
             y = self._get_transform_fn()(x, state)
         return self._slice_rows(y, n)

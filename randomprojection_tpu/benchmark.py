@@ -61,7 +61,7 @@ def pdist2(a):
 
 
 def _mode_project_fn(jax, jnp, name, scale, *, k=None, density=None,
-                     lazy_seed=0):
+                     lazy_seed=0, dma=None):
     """(project(x, r), input_dtype, r_transform) for one MXU mode.
 
     The ``lazy*`` modes run the fused Pallas kernel
@@ -84,7 +84,7 @@ def _mode_project_fn(jax, jnp, name, scale, *, k=None, density=None,
 
         def project(x, r):  # r unused by design: zero R HBM traffic
             return fused_sparse_project(
-                x, lazy_seed, k, density, mxu_mode=mxu_mode
+                x, lazy_seed, k, density, mxu_mode=mxu_mode, dma=dma
             )
 
         in_dtype = jnp.bfloat16 if name == "lazy_bf16" else jnp.float32
@@ -673,12 +673,19 @@ def measure_config1() -> dict:
     }
 
 
-def measure_config3(preset: str = "full") -> dict:
+def measure_config3(preset: str = "full", dma=None, steps=None,
+                    block_n=None, no_cache=False) -> dict:
     """Config-3 (BASELINE.json:9): very-sparse Li RP ``16384→512`` at
     ``density = 1/√d = 1/128``, data-resident, via the fused lazy Pallas
     kernel in split2 mode — R (512×16384 = 32 MiB f32) never exists in HBM.
     TPU-only (the in-kernel PRNG has no CPU/GPU emulation); the TP variant
     of the same kernel is exercised by the multichip dryrun.
+
+    ``dma``/``steps``/``block_n``/``no_cache`` are the isolation levers
+    ``experiments/config3_bisect.py`` sweeps to attribute the r4→r5
+    3.30M→2.88M decay (ROADMAP #3 sub-item): kernel route, anti-cache
+    chain length, row tile, and mask-cache machinery — defaults
+    reproduce the committed methodology exactly.
     """
     import math
 
@@ -695,9 +702,13 @@ def measure_config3(preset: str = "full") -> dict:
     cfg = dict(batch=16384, steps=16, calls=3) if preset == "full" else dict(
         batch=2048, steps=2, calls=2
     )
+    if steps is not None:
+        cfg["steps"] = int(steps)
 
     def project(x):
-        return fused_sparse_project(x, 0, k, density, mxu_mode="split2")
+        return fused_sparse_project(x, 0, k, density, mxu_mode="split2",
+                                    dma=dma, block_n=block_n,
+                                    no_cache=no_cache)
 
     x0 = jax.random.normal(jax.random.key(3), (cfg["batch"], d), jnp.float32)
     rate, elapsed, checksum = _scan_harness(
@@ -714,6 +725,9 @@ def measure_config3(preset: str = "full") -> dict:
     executed = rate * 2 * 2 * d * k / 1e12  # split2: 2 MXU passes
     return {
         "workload": f"verysparse Li density=1/{int(math.sqrt(d))} {d}->{k}, lazy_split2",
+        "transform_dma": (
+            "auto" if dma is None else ("dma" if dma else "single")
+        ),
         "rows_per_s": round(rate, 1),
         "distortion": distortion,
         "elapsed_s": round(elapsed, 4),
@@ -886,7 +900,7 @@ def measure_config4_topk(preset: str = "full") -> dict:
                 ]
                 for f in futs:
                     f.result()
-            except BaseException as e:  # surfaced after join
+            except BaseException as e:  # rplint: allow[RP06] — client-thread errors are collected and re-raised after join (errs[0] below)
                 errs.append(e)
 
         threads = [
@@ -959,7 +973,7 @@ def measure_config4_topk(preset: str = "full") -> dict:
                     ]
                     for f in futs:
                         f.result()
-                except BaseException as e:  # surfaced after join
+                except BaseException as e:  # rplint: allow[RP06] — client-thread errors are collected and re-raised after join (errs[0] below)
                     errs.append(e)
 
             threads = [
@@ -1363,6 +1377,13 @@ def compact_summary(record: dict) -> dict:
             c[k] = _sig(record[k])
     if record.get("timing_suspect") is not None:
         c["timing_suspect"] = bool(record["timing_suspect"])
+    # ISSUE 9 execution-knob provenance: a compact-line-only record must
+    # still say which transform route / chain length produced its rates,
+    # or a single-buffered A/B run could silently become the tripwire
+    # baseline for the DMA default
+    for k in ("transform_dma", "dispatch_steps"):
+        if record.get(k) is not None:
+            c[k] = record[k]
     modes = record.get("all_modes") or {}
     if modes:
         c["all_modes"] = {
@@ -1444,7 +1465,24 @@ def emit_bench_output(record: dict) -> None:
 
 
 def run(preset: str = "full", k: int = 256, d: int = 4096,
-        density: float = 1.0 / 3.0) -> dict:
+        density: float = 1.0 / 3.0, transform_dma=None,
+        dispatch_steps=None) -> dict:
+    """``transform_dma``/``dispatch_steps`` are the ISSUE 9 execution
+    knobs, recorded in the output (and the compact digest) so a committed
+    record is self-describing about which transform route it measured:
+
+    - ``transform_dma``: ``None`` takes the kernel default (the manual
+      double-buffered x DMA route since ISSUE 9); ``False`` pins the
+      single-buffered automatic tiling (the pre-r14 kernel) — the A/B
+      lever for attributing a rate delta to the DMA pipeline.
+    - ``dispatch_steps``: overrides the preset's anti-cache
+      steps-per-dispatch for the headline modes.  The harness already
+      chains its steps through ONE traced dispatch (``_scan_harness``'s
+      ``lax.scan``), so this IS the bench-path dispatch-fusion chain
+      length: call-boundary host gaps (~13% of wall in the r5 trace)
+      amortize by 1/steps.  The anti-cache defenses are call-level and
+      survive any steps value.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -1452,7 +1490,13 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
 
     import math
 
-    cfg = PRESETS[preset]
+    cfg = dict(PRESETS[preset])
+    if dispatch_steps is not None:
+        if int(dispatch_steps) < 1:
+            raise ValueError(
+                f"dispatch_steps must be >= 1, got {dispatch_steps}"
+            )
+        cfg["steps"] = int(dispatch_steps)
     R = kernels.sparse_matrix(jax.random.key(0), k, d, density, jnp.float32)
     scale = 1.0 / math.sqrt(density * k)
 
@@ -1485,7 +1529,8 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
         for name in ("lazy", "lazy_split2", "lazy_bf16",
                      "lazy_f32_bf16data"):
             mode_names.append(name)
-            lazy_kw[name] = dict(k=k, density=density, lazy_seed=lazy_seed)
+            lazy_kw[name] = dict(k=k, density=density, lazy_seed=lazy_seed,
+                                 dma=transform_dma)
             R_by_mode[name] = R_lazy
 
     results = {}
@@ -1551,6 +1596,14 @@ def run(preset: str = "full", k: int = 256, d: int = 4096,
             for n, r in results.items()
         },
         "rows_timed": head["rows_timed"],
+        # ISSUE 9 execution-knob provenance: which transform route the
+        # lazy modes ran ("dma" / "single" / "auto"=kernel default) and
+        # the per-dispatch anti-cache chain length actually used
+        "transform_dma": (
+            "auto" if transform_dma is None
+            else ("dma" if transform_dma else "single")
+        ),
+        "dispatch_steps": cfg["steps"],
         "implied_tflops": head["implied_tflops"],
         "timing_suspect": head["timing_suspect"],
         "elapsed_pass_invariant": elapsed_pass_invariant,

@@ -60,6 +60,20 @@ def _add_common(p):
     p.add_argument("--materialization", default=None,
                    choices=["dense", "lazy"],
                    help="jax backend: 'lazy' = in-kernel mask (TPU only)")
+    p.add_argument("--transform-dma", default=None,
+                   choices=["auto", "on", "off"],
+                   help="jax backend, lazy kernel: x-tile routing — "
+                        "'auto' (default) = manual double-buffered "
+                        "HBM->VMEM DMA (the r14 default route), 'off' "
+                        "pins the single-buffered automatic tiling")
+    p.add_argument("--dispatch-steps", type=_positive_int, default=None,
+                   metavar="K",
+                   help="jax backend, lazy kernel: chain K row-blocks of "
+                        "each transform through ONE traced dispatch "
+                        "(call-boundary host gaps amortize by 1/K; "
+                        "results bit-identical to K separate dispatches; "
+                        "host-upload buffers are donated where XLA can "
+                        "alias them)")
     _add_observability(p)
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
@@ -110,6 +124,11 @@ def _backend_options(args) -> dict:
         opts["precision"] = args.precision
     if getattr(args, "materialization", None):
         opts["materialization"] = args.materialization
+    tdma = getattr(args, "transform_dma", None)
+    if tdma in ("on", "off"):
+        opts["transform_dma"] = tdma == "on"
+    if getattr(args, "dispatch_steps", None):
+        opts["dispatch_steps"] = args.dispatch_steps
     return opts
 
 
@@ -151,6 +170,18 @@ def build_parser():
                    help="output dimension for the headline modes")
     q.add_argument("--density", type=_density_arg, default=1.0 / 3.0,
                    help="mask density for the headline modes")
+    q.add_argument("--transform-dma", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="fused-kernel x routing for the lazy modes: "
+                        "'auto' = kernel default (manual double-buffered "
+                        "DMA since r14), 'off' pins the single-buffered "
+                        "automatic tiling — the A/B lever for attributing "
+                        "a rate delta to the DMA pipeline")
+    q.add_argument("--dispatch-steps", type=_positive_int, default=None,
+                   metavar="K",
+                   help="anti-cache steps chained through one traced "
+                        "dispatch (overrides the preset; call-boundary "
+                        "host gaps amortize by 1/K)")
     _add_observability(q)
 
     q = sub.add_parser(
@@ -612,8 +643,13 @@ def cmd_bench(args):
 
     # full record first, then the ≤2 KB compact digest as the FINAL line —
     # same tail-safe contract as the repo-root bench.py entry point
-    emit_bench_output(run(args.preset, k=args.k, d=args.d,
-                          density=args.density))
+    emit_bench_output(run(
+        args.preset, k=args.k, d=args.d, density=args.density,
+        transform_dma={"auto": None, "on": True, "off": False}[
+            args.transform_dma
+        ],
+        dispatch_steps=args.dispatch_steps,
+    ))
 
 
 def cmd_topk_bench(args):

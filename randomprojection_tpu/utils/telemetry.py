@@ -150,6 +150,14 @@ class EVENTS:
     # backend dispatch + degraded retries
     BACKEND_DISPATCH = "backend.dispatch"
     BACKEND_VMEM_OOM_RETRY = "backend.vmem_oom_retry"
+    # fused transform kernel (ISSUE 9): per-host-dispatch route record
+    # (DMA vs single-buffered, dispatch-fusion chain length), the
+    # DMA→single-buffered scoped-VMEM fallback, and the backend's
+    # multi-step dispatch-fusion record.  Deliberately NOT a family —
+    # rogue ``kernel.dma.*`` names stay lintable (rp02_dma_bad.py).
+    KERNEL_DMA_DISPATCH = "kernel.dma.dispatch"
+    KERNEL_DMA_FALLBACK = "kernel.dma.fallback"
+    BACKEND_DISPATCH_FUSED = "backend.dispatch_fused"
     # ingest hashing
     HASH_BATCH = "hash.batch"
     # simhash query/serving

@@ -53,6 +53,7 @@ __all__ = ["build_report", "render_report", "DEGRADED_EVENTS"]
 # here as "consumed", closing the emitter/consumer drift loop.
 DEGRADED_EVENTS = (
     EVENTS.BACKEND_VMEM_OOM_RETRY,
+    EVENTS.KERNEL_DMA_FALLBACK,
     EVENTS.SIMHASH_TOPK_DENSE_FALLBACK,
     EVENTS.SIMHASH_TOPK_BLOCK_CLAMP,
     EVENTS.TOPK_KERNEL_VMEM_RETRY,
@@ -143,6 +144,11 @@ def build_report(path: str) -> dict:
     orphan_chunks = 0
     topk_dispatches = 0
     topk_queries = 0
+    xform_dispatches = {"dma": 0, "single": 0}
+    xform_rows = {"dma": 0, "single": 0}
+    xform_fused_calls = 0
+    xform_fused_rows = 0
+    xform_fused_steps = 0
     shard_tiles = 0
     shard_fanout = 0
     shard_merges = 0
@@ -220,6 +226,21 @@ def build_report(path: str) -> dict:
             })
         elif name == EVENTS.RECOVER_ORPHAN_CHUNK:
             orphan_chunks += 1
+        elif name == EVENTS.KERNEL_DMA_DISPATCH:
+            # fused transform-kernel host dispatches (ISSUE 9): which
+            # route (manual double-buffered DMA vs the single-buffered
+            # automatic tiling) served how many rows — the doctor's view
+            # of whether the default DMA path is actually the one running
+            route = e.get("path") if e.get("path") in xform_dispatches \
+                else "single"
+            xform_dispatches[route] += 1
+            xform_rows[route] += e.get("rows", 0) or 0
+        elif name == EVENTS.BACKEND_DISPATCH_FUSED:
+            # multi-step dispatch fusion: K row-blocks chained through
+            # one traced dispatch — call-boundary gaps amortize by 1/K
+            xform_fused_calls += 1
+            xform_fused_rows += e.get("rows", 0) or 0
+            xform_fused_steps += e.get("steps", 0) or 0
         elif name == EVENTS.TOPK_KERNEL_DISPATCH:
             # fused serving-kernel dispatches (one per query tile per
             # chunk): the doctor's view of how much top-k traffic the
@@ -307,6 +328,25 @@ def build_report(path: str) -> dict:
             "overlap_ratio_est": round(overlap, 3),
         },
         "queue_depth": queue,
+        "transform": (
+            {
+                "kernel_dispatches": dict(xform_dispatches),
+                "kernel_rows": dict(xform_rows),
+                **(
+                    {
+                        "fused_dispatch_calls": xform_fused_calls,
+                        "fused_dispatch_rows": xform_fused_rows,
+                        "fused_dispatch_mean_steps": round(
+                            xform_fused_steps / xform_fused_calls, 2
+                        ),
+                    }
+                    if xform_fused_calls
+                    else {}
+                ),
+            }
+            if (any(xform_dispatches.values()) or xform_fused_calls)
+            else None
+        ),
         "serving": (
             {
                 "topk_kernel_dispatches": topk_dispatches,
@@ -405,6 +445,20 @@ def render_report(report: dict) -> str:
             f"/mean {q['mean']}"
             + (f" (capacity {q['capacity']})" if q.get("capacity") else "")
         )
+    xf = report.get("transform")
+    if xf:
+        kd, kr = xf["kernel_dispatches"], xf["kernel_rows"]
+        lines.append(
+            f"transform kernel: {kd['dma']} DMA dispatch(es) "
+            f"({kr['dma']} rows), {kd['single']} single-buffered "
+            f"({kr['single']} rows)"
+        )
+        if xf.get("fused_dispatch_calls"):
+            lines.append(
+                f"  dispatch fusion: {xf['fused_dispatch_calls']} chained "
+                f"call(s), {xf['fused_dispatch_rows']} rows, mean "
+                f"{xf['fused_dispatch_mean_steps']} steps/call"
+            )
     sv = report.get("serving")
     if sv:
         lines.append(

@@ -1,0 +1,17 @@
+"""RP02 fixture (ISSUE 9 satellite): a transform-kernel path emitting a
+``kernel.dma.*`` event name that is NOT in ``telemetry.EVENTS``.
+Linted against the REAL registry — the kernel.dma namespace deliberately
+has NO family prefix, so every transform-route event must be
+individually registered (a family would wave rogue names through the
+doctor's transform section and the degraded audit)."""
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+
+def dispatch_with_unregistered_event(rows, steps):
+    # VIOLATION: a DMA-route event dodging the registry — invisible to
+    # the doctor's transform section and the degraded-event audit
+    telemetry.emit("kernel.dma.rogue_retry", rows=rows, steps=steps)
+    # ok: the registered route-record and fallback events
+    telemetry.emit(EVENTS.KERNEL_DMA_DISPATCH, rows=rows, steps=steps)
+    telemetry.emit(EVENTS.KERNEL_DMA_FALLBACK, rows=rows)

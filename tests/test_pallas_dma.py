@@ -1,0 +1,469 @@
+"""Double-buffered x DMA + multi-step dispatch fusion (ISSUE 9).
+
+The r14 transform work has two value-preserving execution knobs:
+
+- ``dma``: the fused kernel's x routing — manual double-buffered
+  HBM→VMEM DMA (two revolving VMEM slots + semaphores, the default) vs
+  the pre-r14 single-buffered automatic pipeline.  Both contract the
+  identical mask blocks against the identical x tiles in the identical
+  order, so they must be BIT-identical.
+- ``fused_project_multistep(steps=K)``: K contiguous row-blocks chained
+  through one traced dispatch — must be bit-identical to K separate
+  ``fused_sparse_project`` calls on the same row split.
+
+Everything here runs the REAL kernels (DMAs, double buffering, mask
+cache, accumulation) under the Pallas interpreter on CPU — the
+interpreter substitutes a jnp integer-hash stream for the hardware PRNG
+(same distribution and (seed, block) keying, different stream), and
+``pallas_sparse_matrix(interpret=True)`` materializes the matching
+matrix, so parity against the numpy contraction is exact-shape
+meaningful.  On-chip values are covered by ``RP_TEST_TPU=1`` runs of
+tests/test_pallas.py.
+"""
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu.ops import pallas_kernels as pk
+
+OOM_MSG = "Mosaic failed: scoped vmem allocation exceeds the limit"
+
+
+def _x(n, d, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(np.float32)
+
+
+def _jnp(a):
+    import jax.numpy as jnp
+
+    return jnp.asarray(a)
+
+
+# -- DMA vs single-buffer bit-parity ------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n,d",
+    [
+        (70, 700),    # ragged rows AND ragged contraction (d % 512 != 0)
+        (64, 512),    # exact one-block shape
+        (130, 1100),  # multiple ragged column blocks
+        (3, 520),     # fewer rows than any tile
+    ],
+)
+@pytest.mark.parametrize("mxu_mode", ["f32", "split2", "bf16"])
+def test_dma_single_parity_ragged(n, d, mxu_mode):
+    """DMA and single-buffered routes are bit-identical on every ragged
+    (n, d) combination, and both match X @ Rᵀ for the interpreter's
+    materialized matrix."""
+    x = _x(n, d)
+    xj = _jnp(x).astype("bfloat16" if mxu_mode == "bf16" else "float32")
+    k = 16
+    y_dma = np.asarray(
+        pk.fused_sparse_project(
+            xj, 11, k, 0.25, mxu_mode=mxu_mode, interpret=True, dma=True
+        )
+    )
+    y_sb = np.asarray(
+        pk.fused_sparse_project(
+            xj, 11, k, 0.25, mxu_mode=mxu_mode, interpret=True, dma=False
+        )
+    )
+    np.testing.assert_array_equal(y_dma, y_sb)
+    assert y_dma.shape == (n, k)
+    R = np.asarray(pk.pallas_sparse_matrix(11, k, d, 0.25, interpret=True))
+    ref = np.asarray(xj, dtype=np.float32) @ R.T
+    tol = dict(rtol=5e-3, atol=0.05) if mxu_mode == "bf16" else dict(
+        rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(y_dma, ref, **tol)
+
+
+def test_dma_default_route_and_explicit_block_n():
+    """``dma=None`` resolves to the DMA default (``_DMA_DEFAULT`` is the
+    r14 acceptance criterion), and an explicit row tile keeps parity on
+    a padded multi-tile grid."""
+    assert pk._DMA_DEFAULT is True
+    x = _jnp(_x(24, 512))
+    y_default = np.asarray(
+        pk.fused_sparse_project(x, 3, 8, 0.5, block_n=16, interpret=True)
+    )
+    y_pinned = np.asarray(
+        pk.fused_sparse_project(
+            x, 3, 8, 0.5, block_n=16, interpret=True, dma=False
+        )
+    )
+    np.testing.assert_array_equal(y_default, y_pinned)
+
+
+def test_dma_cache_off_parity():
+    """The four (dma × cache) rungs of the degraded ladder all produce
+    the identical output — neither knob may change values."""
+    x = _jnp(_x(96, 1030, seed=4))
+    outs = [
+        np.asarray(
+            pk.fused_sparse_project(
+                x, 9, 24, 1 / 3, block_n=32, interpret=True,
+                dma=dma, no_cache=nc,
+            )
+        )
+        for dma in (True, False)
+        for nc in (False, True)
+    ]
+    for o in outs[1:]:
+        np.testing.assert_array_equal(outs[0], o)
+
+
+def test_dma_block_offset_shards_same_matrix():
+    """Under feature-axis TP each shard regenerates its own column range
+    via ``block_offset`` — the DMA route must honor it identically."""
+    x = _x(40, 1024, seed=7)
+    xj = _jnp(x)
+    k = 16
+    full = np.asarray(
+        pk.fused_sparse_project(xj, 5, k, 0.5, interpret=True, dma=True)
+    )
+    lo = np.asarray(
+        pk.fused_sparse_project(
+            _jnp(x[:, :512]), 5, k, 0.5, interpret=True, dma=True
+        )
+    )
+    hi = np.asarray(
+        pk.fused_sparse_project(
+            _jnp(x[:, 512:]), 5, k, 0.5, block_offset=1, interpret=True,
+            dma=True,
+        )
+    )
+    # psum over shards == unsharded contraction (identical streams/order)
+    np.testing.assert_allclose(lo + hi, full, rtol=1e-5, atol=1e-5)
+
+
+# -- multi-step dispatch fusion -----------------------------------------------
+
+
+@pytest.mark.parametrize("steps", [2, 3, 7])
+def test_multistep_bit_identical_to_separate_dispatches(steps):
+    """The dispatch-fusion contract: ``steps`` row-blocks through one
+    trace == ``steps`` separate dispatches on the same contiguous
+    ceil(n/steps) row split, bit-identical (ragged final block
+    included)."""
+    n = 70
+    x = _jnp(_x(n, 700, seed=1))
+    y = np.asarray(
+        pk.fused_project_multistep(
+            x, 13, 16, 0.25, steps=steps, interpret=True
+        )
+    )
+    per = -(-n // steps)
+    parts = [
+        np.asarray(
+            pk.fused_sparse_project(
+                x[lo:min(lo + per, n)], 13, 16, 0.25, interpret=True
+            )
+        )
+        for lo in range(0, n, per)
+    ]
+    np.testing.assert_array_equal(y, np.concatenate(parts, axis=0))
+
+
+def test_multistep_clamps_and_degenerates():
+    """steps > n clamps to the row count; steps=1 is exactly the plain
+    dispatch; donate=True changes ownership, never values."""
+    x = _jnp(_x(5, 600, seed=2))
+    plain = np.asarray(
+        pk.fused_sparse_project(x, 1, 8, 0.5, interpret=True)
+    )
+    one = np.asarray(
+        pk.fused_project_multistep(x, 1, 8, 0.5, steps=1, interpret=True)
+    )
+    np.testing.assert_array_equal(plain, one)
+    clamped = np.asarray(
+        pk.fused_project_multistep(x, 1, 8, 0.5, steps=99, interpret=True)
+    )
+    per_row = [
+        np.asarray(pk.fused_sparse_project(x[i:i + 1], 1, 8, 0.5,
+                                           interpret=True))
+        for i in range(5)
+    ]
+    np.testing.assert_array_equal(clamped, np.concatenate(per_row, axis=0))
+    donated = np.asarray(
+        pk.fused_project_multistep(
+            _jnp(_x(5, 600, seed=2)), 1, 8, 0.5, steps=2, interpret=True,
+            donate=True,
+        )
+    )
+    np.testing.assert_array_equal(
+        donated,
+        np.asarray(pk.fused_project_multistep(
+            _jnp(_x(5, 600, seed=2)), 1, 8, 0.5, steps=2, interpret=True,
+        )),
+    )
+    # steps==1 + donate stays on the donating chain (the invalidation
+    # contract holds on the degenerate path), values still identical
+    donated1 = np.asarray(
+        pk.fused_project_multistep(
+            _jnp(_x(5, 600, seed=2)), 1, 8, 0.5, steps=1, interpret=True,
+            donate=True,
+        )
+    )
+    np.testing.assert_array_equal(donated1, plain)
+
+
+# -- VMEM-OOM degraded-retry ladder (fake OOM, r6 convention) -----------------
+
+
+def _fake_oom_on(monkeypatch, trip):
+    """Patch the jitted fused impl: rungs matching ``trip(dma, no_cache)``
+    raise a classified scoped-VMEM OOM, the rest run the real kernel."""
+    real = pk._fused_impl
+    calls = []
+
+    def impl(*a, **kw):
+        calls.append((kw["dma"], kw["no_cache"]))
+        if trip(kw["dma"], kw["no_cache"]):
+            raise RuntimeError(OOM_MSG)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pk, "_fused_impl", impl)
+    return calls
+
+
+def test_vmem_oom_dma_falls_back_single_buffered(monkeypatch):
+    """A scoped-VMEM OOM on the DMA rung lands on the single-buffered
+    tiling (same values), records ``kernel.dma.fallback``, and memoizes
+    the key so later dispatches skip the failing route."""
+    from randomprojection_tpu.utils import telemetry
+
+    x = _jnp(_x(40, 600, seed=3))
+    ref = np.asarray(
+        pk.fused_sparse_project(x, 2, 8, 0.5, interpret=True, dma=False)
+    )
+    key = ((40, 600), None, 8, "f32")
+    calls = _fake_oom_on(monkeypatch, lambda dma, nc: dma)
+    before = telemetry.registry().snapshot()["counters"].get(
+        "kernel.dma.fallbacks", 0
+    )
+    try:
+        got = np.asarray(
+            pk.fused_sparse_project(x, 2, 8, 0.5, interpret=True, dma=True)
+        )
+        np.testing.assert_array_equal(ref, got)
+        assert calls == [(True, False), (False, False)]
+        assert key in pk._NO_DMA_KEYS
+        assert key not in pk._NO_CACHE_KEYS  # cache rung never reached
+        after = telemetry.registry().snapshot()["counters"].get(
+            "kernel.dma.fallbacks", 0
+        )
+        assert after == before + 1
+        # memoized: the DMA rung is not attempted again for this key
+        got2 = np.asarray(
+            pk.fused_sparse_project(x, 2, 8, 0.5, interpret=True, dma=True)
+        )
+        np.testing.assert_array_equal(ref, got2)
+        assert calls[2:] == [(False, False)]
+    finally:
+        pk._NO_DMA_KEYS.discard(key)
+
+
+def test_vmem_oom_walks_full_ladder_to_no_cache(monkeypatch):
+    """When the single-buffered retry ALSO blows VMEM the ladder ends on
+    (single-buffered, no-cache) — the regenerate-every-step floor — and
+    memoizes both degradations."""
+    x = _jnp(_x(48, 520, seed=6))
+    ref = np.asarray(
+        pk.fused_sparse_project(
+            x, 4, 8, 0.5, interpret=True, dma=False, no_cache=True
+        )
+    )
+    key = ((48, 520), None, 8, "f32")
+    calls = _fake_oom_on(
+        monkeypatch, lambda dma, nc: dma or not nc
+    )
+    try:
+        got = np.asarray(
+            pk.fused_sparse_project(x, 4, 8, 0.5, interpret=True, dma=True)
+        )
+        np.testing.assert_array_equal(ref, got)
+        assert calls == [(True, False), (False, False), (False, True)]
+        assert key in pk._NO_DMA_KEYS
+        assert key in pk._NO_CACHE_KEYS
+    finally:
+        pk._NO_DMA_KEYS.discard(key)
+        pk._NO_CACHE_KEYS.discard(key)
+
+
+def test_non_vmem_errors_are_not_swallowed(monkeypatch):
+    """Only classified VMEM OOMs take the ladder: any other failure
+    surfaces unmemoized."""
+    x = _jnp(_x(16, 512, seed=8))
+    key = ((16, 512), None, 8, "f32")
+
+    def boom(*a, **kw):
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(pk, "_fused_impl", boom)
+    with pytest.raises(RuntimeError, match="boom"):
+        pk.fused_sparse_project(x, 0, 8, 0.5, interpret=True, dma=True)
+    assert key not in pk._NO_DMA_KEYS
+    assert key not in pk._NO_CACHE_KEYS
+
+
+def test_multistep_vmem_oom_ladder(monkeypatch):
+    """``fused_project_multistep`` walks the same ladder (its key carries
+    the chain length so a failing chained shape never poisons the plain
+    dispatch's key)."""
+    x = _jnp(_x(30, 600, seed=9))
+    ref = np.asarray(
+        pk.fused_project_multistep(
+            x, 5, 8, 0.5, steps=3, interpret=True, dma=False
+        )
+    )
+    key = ((30, 600), None, 8, "f32", 3)
+    real = pk._multistep_impl
+    calls = []
+
+    def impl(*a, **kw):
+        calls.append((kw["dma"], kw["no_cache"]))
+        if kw["dma"]:
+            raise RuntimeError(OOM_MSG)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pk, "_multistep_impl", impl)
+    try:
+        got = np.asarray(
+            pk.fused_project_multistep(
+                x, 5, 8, 0.5, steps=3, interpret=True, dma=True
+            )
+        )
+        np.testing.assert_array_equal(ref, got)
+        assert calls == [(True, False), (False, False)]
+        assert key in pk._NO_DMA_KEYS
+        assert ((30, 600), None, 8, "f32") not in pk._NO_DMA_KEYS
+    finally:
+        pk._NO_DMA_KEYS.discard(key)
+
+
+# -- VMEM budget math ---------------------------------------------------------
+
+
+def test_reserved_bytes_budgets_dma_value_plane():
+    """The DMA route reserves one extra x-tile value plane (the dynamic
+    slot gather Mosaic materializes) on top of the two-slot footprint the
+    automatic pipeline also pays."""
+    for mode in ("f32", "split2", "bf16"):
+        itemsize = 2 if mode == "bf16" else 4
+        for bn in (256, 512, 1024):
+            base = pk._reserved_bytes(bn, 256, mode, itemsize, dma=False)
+            with_dma = pk._reserved_bytes(bn, 256, mode, itemsize, dma=True)
+            assert with_dma == base + bn * pk.BLOCK_D * itemsize
+
+
+def test_auto_block_n_never_grows_under_dma():
+    """Re-budgeting for the second slot can only shrink (or keep) the
+    auto row tile — a DMA tile must never be sized past the budget the
+    single-buffered kernel proved."""
+    for n, d, k, mode in [
+        (131072, 4096, 256, "split2"),
+        (16384, 16384, 512, "split2"),
+        (8192, 4096, 256, "f32"),
+        (1024, 1024, 64, "bf16"),
+    ]:
+        bn_dma = pk._auto_block_n(n, d, k, mode, dma=True)
+        bn_sb = pk._auto_block_n(n, d, k, mode, dma=False)
+        assert bn_dma <= bn_sb
+        itemsize = 2 if mode == "bf16" else 4
+        assert (
+            pk._reserved_bytes(bn_dma, k, mode, itemsize, dma=True)
+            <= pk._VMEM_LIMIT
+        )
+
+
+# -- backend knobs + telemetry ------------------------------------------------
+
+
+def test_backend_option_validation():
+    from randomprojection_tpu.backends.jax_backend import JaxBackend
+
+    with pytest.raises(ValueError, match="dispatch_steps"):
+        JaxBackend(dispatch_steps=0)
+    with pytest.raises(ValueError, match="transform_dma"):
+        JaxBackend(transform_dma="yes")
+    b = JaxBackend(dispatch_steps=4, transform_dma=False)
+    assert b.dispatch_steps == 4 and b.transform_dma is False
+    assert JaxBackend().dispatch_steps == 1
+    assert JaxBackend().transform_dma is None
+
+
+def test_kernel_dispatch_telemetry_and_doctor(tmp_path):
+    """Every host dispatch records its route; the doctor's transform
+    section aggregates routes/rows and the dispatch-fusion chain."""
+    from randomprojection_tpu.utils import telemetry
+    from randomprojection_tpu.utils.trace_report import build_report
+
+    p = str(tmp_path / "dma.jsonl")
+    telemetry.configure(p)
+    try:
+        x = _jnp(_x(20, 600, seed=10))
+        pk.fused_sparse_project(x, 0, 8, 0.5, interpret=True)  # default=dma
+        pk.fused_sparse_project(x, 0, 8, 0.5, interpret=True, dma=False)
+        pk.fused_project_multistep(x, 0, 8, 0.5, steps=2, interpret=True)
+    finally:
+        telemetry.shutdown()
+    report = build_report(p)
+    xf = report["transform"]
+    # plain dma + the multistep chain (its dispatch event carries steps=2)
+    assert xf["kernel_dispatches"] == {"dma": 2, "single": 1}
+    assert xf["kernel_rows"] == {"dma": 40, "single": 20}
+    assert report["degraded"][
+        "kernel.dma.fallback"
+    ] == 0  # explicit zero: nothing degraded
+    from randomprojection_tpu.utils.telemetry import read_events
+
+    steps = [
+        e["steps"] for e in read_events(p)
+        if e["event"] == "kernel.dma.dispatch"
+    ]
+    assert sorted(steps) == [1, 1, 2]
+
+
+def test_multistep_chain_length_reflects_launches(tmp_path):
+    """Telemetry records the launches actually chained, not the knob:
+    the clamp + ceil-split can round the chunk count below the request
+    (n=10, steps=7 → per=2 → 5 launches)."""
+    from randomprojection_tpu.ops.pallas_kernels import (
+        multistep_chain_length,
+    )
+    from randomprojection_tpu.utils import telemetry
+    from randomprojection_tpu.utils.telemetry import read_events
+
+    assert multistep_chain_length(10, 7) == 5
+    assert multistep_chain_length(70, 3) == 3
+    assert multistep_chain_length(4, 8) == 4  # clamped to the row count
+    assert multistep_chain_length(1, 5) == 1
+
+    p = str(tmp_path / "chain.jsonl")
+    telemetry.configure(p)
+    try:
+        x = _jnp(_x(10, 520, seed=12))
+        pk.fused_project_multistep(x, 0, 8, 0.5, steps=7, interpret=True)
+    finally:
+        telemetry.shutdown()
+    steps = [
+        e["steps"] for e in read_events(p)
+        if e["event"] == "kernel.dma.dispatch"
+    ]
+    assert steps == [5]
+
+
+def test_backend_dispatch_fused_event_registered():
+    """The three r14 events are registry members (rp02_dma_bad.py pins
+    the negative: a rogue ``kernel.dma.*`` literal fails the lint)."""
+    from randomprojection_tpu.utils.telemetry import EVENTS, registered_event
+
+    for name in (
+        EVENTS.KERNEL_DMA_DISPATCH,
+        EVENTS.KERNEL_DMA_FALLBACK,
+        EVENTS.BACKEND_DISPATCH_FUSED,
+    ):
+        assert registered_event(name)
+    assert not registered_event("kernel.dma.bogus")

@@ -159,6 +159,29 @@ def test_rp02_unregistered_topk_kernel_event_fixture():
     assert not suppressed
 
 
+def test_rp02_unregistered_dma_event_caught_against_real_registry():
+    """ISSUE 9 satellite: an unregistered ``kernel.dma.*`` emit is
+    caught against the REAL shipped registry — the transform-route
+    namespace has no family prefix, so each event must be individually
+    registered, and the registered dispatch/fallback events in the same
+    fixture stay clean."""
+    real = rplint.load_event_registry(
+        open(os.path.join(
+            rplint.package_root(), "utils", "telemetry.py"
+        )).read()
+    )
+    assert real is not None and real.knows("kernel.dma.dispatch")
+    assert real.knows("kernel.dma.fallback")
+    assert real.knows("backend.dispatch_fused")
+    assert not real.knows("kernel.dma.rogue_retry")
+    active, suppressed = _split(
+        _lint_fixture("rp02_dma_bad.py", registry=real)
+    )
+    assert [f.rule for f in active] == ["RP02"]
+    assert "'kernel.dma.rogue_retry'" in active[0].message
+    assert not suppressed
+
+
 def test_rp04_zero_and_negative_maxsize_are_unbounded():
     """Python treats any maxsize <= 0 as unbounded — every spelling of
     that must trip RP04, not just the bare constructor."""

@@ -563,14 +563,19 @@ def test_cli_bench_forwards_custom_shapes(monkeypatch, capsys):
 
     captured = {}
 
-    def fake_run(preset, k=256, d=4096, density=1 / 3):
-        captured.update(preset=preset, k=k, d=d, density=density)
+    def fake_run(preset, k=256, d=4096, density=1 / 3,
+                 transform_dma=None, dispatch_steps=None):
+        captured.update(preset=preset, k=k, d=d, density=density,
+                        transform_dma=transform_dma,
+                        dispatch_steps=dispatch_steps)
         return {"metric": "fake", "value": 1}
 
     monkeypatch.setattr(benchmark, "run", fake_run)
     cli.main(["bench", "--preset", "smoke", "--d", "512", "--k", "32",
-              "--density", "0.5"])
-    assert captured == {"preset": "smoke", "k": 32, "d": 512, "density": 0.5}
+              "--density", "0.5", "--transform-dma", "off",
+              "--dispatch-steps", "4"])
+    assert captured == {"preset": "smoke", "k": 32, "d": 512, "density": 0.5,
+                        "transform_dma": False, "dispatch_steps": 4}
     # tail-safe output contract: full record line first, compact digest
     # as the FINAL line
     lines = capsys.readouterr().out.splitlines()
