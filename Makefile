@@ -1,15 +1,25 @@
 # Repo verification entry points (ISSUE r8 satellite; r9 added the
-# staged-ingest leg; r10 the static-analysis gate).
+# staged-ingest leg; r10 the static-analysis gate; r11/ISSUE 11 the
+# flow-sensitive rules + baseline-diffed CI gate).
 #
-#   make verify        rplint static analysis, the crash-recovery smoke
-#                      (subprocess SIGKILL/resume fault matrix), then
-#                      the tier-1 suite (the ROADMAP.md command) + a
-#                      doctor smoke run, so the telemetry/report path
-#                      cannot rot
+#   make verify        rplint static analysis (plain + baseline-diffed),
+#                      the crash-recovery smoke (subprocess
+#                      SIGKILL/resume fault matrix), then the tier-1
+#                      suite (the ROADMAP.md command) + a doctor smoke
+#                      run, so the telemetry/report path cannot rot
 #   make lint          rplint (analysis/rplint.py via `cli lint`): span
 #                      balance, event-registry drift, hot-path host
-#                      syncs, thread hygiene, ops/ determinism, silent
-#                      swallows — non-zero on any unsuppressed finding
+#                      syncs (syntactic + one call deep), thread hygiene
+#                      + flow-sensitive shutdown protocol, ops/
+#                      determinism, silent swallows, Pallas DMA
+#                      copy/wait/budget discipline — non-zero on any
+#                      unsuppressed finding
+#   make lint-ci       `cli lint --json --baseline .rplint_baseline.json`:
+#                      fails only on findings NOT in the committed
+#                      baseline (rule+path+message matching, so line
+#                      drift never re-flags) — the gate new strict rules
+#                      land behind; exit 2 = internal error, never
+#                      silent success off a partial run
 #   make tier1         just the test suite
 #   make kernel-smoke  interpreter-mode fused top-k kernel (ISSUE 7) on
 #                      a toy index, parity-asserted against the scan
@@ -40,14 +50,22 @@ SHELL := /bin/bash
 PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
-.PHONY: verify lint tier1 kernel-smoke transform-smoke shard-smoke \
+.PHONY: verify lint lint-ci tier1 kernel-smoke transform-smoke shard-smoke \
         recover-smoke doctor-smoke
 
-verify: lint kernel-smoke transform-smoke shard-smoke recover-smoke tier1 \
-        doctor-smoke
+verify: lint lint-ci kernel-smoke transform-smoke shard-smoke recover-smoke \
+        tier1 doctor-smoke
 
 lint:
 	$(PYTHON) -m randomprojection_tpu lint
+
+lint-ci:
+	$(PYTHON) -m randomprojection_tpu lint --json \
+	  --baseline .rplint_baseline.json > /dev/null \
+	  || { rc=$$?; \
+	       $(PYTHON) -m randomprojection_tpu lint --baseline .rplint_baseline.json; \
+	       exit $$rc; }
+	@echo "lint-ci OK: zero non-baselined findings"
 
 kernel-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import numpy as np; \

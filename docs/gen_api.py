@@ -78,6 +78,8 @@ def main():
     import randomprojection_tpu.serialize as serialize
     import randomprojection_tpu.streaming as streaming
     import randomprojection_tpu.parallel as parallel
+    from randomprojection_tpu.analysis import cfg as analysis_cfg
+    from randomprojection_tpu.analysis import flowrules as analysis_flowrules
     from randomprojection_tpu.analysis import rplint
     from randomprojection_tpu.ops import (
         hashing,
@@ -102,6 +104,8 @@ def main():
         ("`randomprojection_tpu.utils.telemetry`", telemetry),
         ("`randomprojection_tpu.utils.trace_report`", trace_report),
         ("`randomprojection_tpu.analysis.rplint`", rplint),
+        ("`randomprojection_tpu.analysis.cfg`", analysis_cfg),
+        ("`randomprojection_tpu.analysis.flowrules`", analysis_flowrules),
     ]:
         lines += [f"## {title}", ""]
         for name in getattr(mod, "__all__", []):

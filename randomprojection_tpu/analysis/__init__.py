@@ -1,28 +1,40 @@
 """Static analysis for the pipeline's hand-enforced contracts.
 
-The streaming/serving stack (r6–r9) is held together by conventions
+The streaming/serving stack (r6–r14) is held together by conventions
 that, until this package existed, only code review enforced: spans must
-always be ended, queues must be bounded, threads must be joined, hot
-paths must not block on host syncs, emitted event names must stay in
-agreement with ``telemetry.EVENTS`` / ``trace_report`` / the docs, and
-broad ``except`` handlers must not swallow errors silently.
+always be ended, queues must be bounded, threads must be joined on
+every shutdown path, hot paths must not block on host syncs (not even
+one call away), DMA copies must be waited before their slot revolves,
+emitted event names must stay in agreement with ``telemetry.EVENTS`` /
+``trace_report`` / the docs, and broad ``except`` handlers must not
+swallow errors silently.
 
-``rplint`` is the AST-based checker that turns those conventions into
-rules (RP01–RP06, see ``rplint.RULES``), each suppressible per line with
-an inline pragma carrying a reason::
+``rplint`` is the checker that turns those conventions into rules
+(RP01–RP09, see ``rplint.RULES``).  Since ISSUE 11 it is a small
+flow-sensitive framework: ``cfg.py`` builds statement-level CFGs (with
+Pallas ``@pl.when``/``fori_loop`` splicing) and a one-level
+intra-package call index; ``flowrules.py`` implements the
+path-sensitive rules (RP07 DMA discipline, RP08 thread/queue protocol,
+RP09 interprocedural host-sync) on top; ``rplint.py`` keeps the
+per-line rules, the pragma grammar, and the CLI.  Each finding is
+suppressible per line with an inline pragma carrying a reason::
 
     # rplint: allow[RP03] — d2h already started at dispatch
 
 Entry points: ``cli lint`` / ``make lint`` (runs over the shipped
-package and must exit 0), ``make verify`` (lint before tier-1), and the
-library surface below for programmatic use.  Pure stdlib — importing
-this package never pulls jax/numpy in.
+package and must exit 0 — exit 1 means findings, exit 2 an internal
+error, never silent success off a partial run), ``make lint-ci``
+(``--baseline .rplint_baseline.json``: fail only on NEW findings),
+``make verify`` (both before tier-1), and the library surface below for
+programmatic use.  Pure stdlib — importing this package never pulls
+jax/numpy in.
 """
 
 from randomprojection_tpu.analysis.rplint import (
     RULES,
     Finding,
     check_registry_drift,
+    diff_baseline,
     lint_package,
     lint_source,
     load_event_registry,
@@ -33,6 +45,7 @@ __all__ = [
     "RULES",
     "Finding",
     "check_registry_drift",
+    "diff_baseline",
     "lint_package",
     "lint_source",
     "load_event_registry",

@@ -1,0 +1,885 @@
+"""Flow-sensitive rplint rules RP07-RP09 (ISSUE 11).
+
+Built on the ``cfg`` substrate.  Each rule function returns plain
+``(line, message)`` pairs; ``rplint.py`` wraps them into findings,
+applies pragma suppression, and owns scoping (which modules each rule
+runs on).
+
+- **RP07 DMA discipline** — inside Pallas kernel bodies: every
+  ``make_async_copy`` start must reach a matching ``.wait()`` on all
+  paths (CFG query, pl.when/fori_loop splicing included); revolving
+  slot indices must stay within the declared slot count (the affine
+  offset algebra: a start at ``base+c`` matched by a wait at ``base+w``
+  re-targets its slot after ``K`` iterations, so ``0 <= c-w < K`` or
+  the DMA engine overwrites an in-flight buffer); the revolving modulus
+  must equal a declared slot count; and the module's VMEM budget
+  function must charge every VMEM operand the kernels actually allocate
+  (allocation dims re-derived from the AST, cross-checked against the
+  budget function's name set).
+- **RP08 thread/queue protocol** — every thread started in a function
+  is joined on every path out of it (early returns, explicit raises and
+  try/finally modeled); threads stored on ``self`` are joined by the
+  class, reachable from its close-like method; a shutdown sentinel is
+  enqueued unconditionally from ``close()`` (only closed-flag guards
+  may skip it); and no cursor commit dominates its batch's ``yield``
+  (the ack-after-yield contract).
+- **RP09 interprocedural host-sync** — a host sync hidden one call away
+  from a hot loop (the exact bug class r9 fixed by hand in
+  ``query_topk``): loop-body calls resolve one level through the
+  package index, and a callee containing an unsuppressed
+  ``np.asarray`` / ``.block_until_ready`` / ``jax.device_get`` /
+  ``float()``-on-expression is reported at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from randomprojection_tpu.analysis.cfg import (
+    CFG,
+    ModuleInfo,
+    PackageIndex,
+    build_cfg,
+    dominators,
+    dotted as _dotted,
+    exit_reachable_without,
+    index_module,
+    node_reachable_without,
+    parents_map as _parents_map,
+    shallow_walk,
+)
+
+__all__ = [
+    "host_sync_what",
+    "rule_rp07",
+    "rule_rp08",
+    "rule_rp09",
+]
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# -- the host-sync detector (shared by RP03 and RP09) ------------------------
+
+_HOST_SYNCS = {"asarray": ("np", "numpy"), "device_get": ("jax",)}
+
+
+def host_sync_what(call: ast.Call) -> Optional[str]:
+    """Human-readable description of the host sync this call performs,
+    or None.  The single definition both the syntactic rule (RP03) and
+    the interprocedural rule (RP09) share, so the two can never drift
+    on what counts as a sync."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        bases = _HOST_SYNCS.get(f.attr)
+        if bases and isinstance(f.value, ast.Name) and f.value.id in bases:
+            return f"{f.value.id}.{f.attr}"
+        if f.attr == "block_until_ready":
+            return ".block_until_ready()"
+    elif isinstance(f, ast.Name) and f.id == "float" and call.args:
+        # float(scalar_name) is fine; float(<expression>) on an array
+        # element/reduction forces a device sync
+        if not isinstance(call.args[0], (ast.Name, ast.Constant)):
+            return "float() on an expression"
+    return None
+
+
+# -- RP07: DMA discipline ----------------------------------------------------
+
+
+def _is_async_copy(call: ast.Call) -> bool:
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else ""
+    )
+    return name == "make_async_copy"
+
+
+def _slot_index(arg: ast.AST) -> Tuple[Optional[str], Optional[ast.AST]]:
+    """``buf.at[IDX]`` -> (buffer name, IDX ast); plain names -> (name,
+    None); anything else -> (None, None)."""
+    if isinstance(arg, ast.Subscript) and isinstance(
+        arg.value, ast.Attribute
+    ) and arg.value.attr == "at" and isinstance(arg.value.value, ast.Name):
+        return arg.value.value.id, arg.slice
+    if isinstance(arg, ast.Name):
+        return arg.id, None
+    return None, None
+
+
+def _mod_k(idx: Optional[ast.AST]) -> Tuple[Optional[ast.AST], Optional[int]]:
+    """``E % K`` -> (E, K) for constant K; otherwise (idx, None)."""
+    if isinstance(idx, ast.BinOp) and isinstance(idx.op, ast.Mod) and \
+            isinstance(idx.right, ast.Constant) and isinstance(
+                idx.right.value, int):
+        return idx.left, idx.right.value
+    return idx, None
+
+
+def _affine(expr: Optional[ast.AST]) -> Tuple[Optional[str], Optional[int]]:
+    """Normalize a slot-phase expression to (base name dump, constant
+    offset): ``t`` -> (t, 0), ``t + 1`` -> (t, 1), ``3`` -> (None, 3);
+    anything else -> (None, None)."""
+    if expr is None:
+        return None, None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return None, expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id, 0
+    if isinstance(expr, ast.BinOp) and isinstance(
+        expr.op, (ast.Add, ast.Sub)
+    ):
+        sign = 1 if isinstance(expr.op, ast.Add) else -1
+        if isinstance(expr.left, ast.Name) and isinstance(
+            expr.right, ast.Constant
+        ) and isinstance(expr.right.value, int):
+            return expr.left.id, sign * expr.right.value
+        if isinstance(expr.right, ast.Name) and isinstance(
+            expr.left, ast.Constant
+        ) and isinstance(expr.left.value, int) and sign == 1:
+            return expr.right.id, expr.left.value
+    return None, None
+
+
+class _CopyFamily:
+    """One DMA copy lineage inside a kernel: a helper def returning
+    ``make_async_copy`` (revolving slots keyed by the helper's
+    argument) or a named descriptor variable (single slot)."""
+
+    def __init__(self, name: str, line: int,
+                 slot_k: Optional[int], sem_k: Optional[int],
+                 idx_mismatch: bool):
+        self.name = name
+        self.line = line
+        self.slot_k = slot_k      # revolving modulus of the buffer index
+        self.sem_k = sem_k        # revolving modulus of the semaphore index
+        self.idx_mismatch = idx_mismatch
+        self.starts: List[Tuple[int, Optional[str], Optional[int], int]] = []
+        self.waits: List[Tuple[int, Optional[str], Optional[int], int]] = []
+
+
+def _collect_families(func: ast.AST) -> Dict[str, _CopyFamily]:
+    fams: Dict[str, _CopyFamily] = {}
+    for n in ast.walk(func):
+        if isinstance(n, _FUNC_NODES) and n is not func:
+            for r in ast.walk(n):
+                if isinstance(r, ast.Return) and isinstance(
+                    r.value, ast.Call
+                ) and _is_async_copy(r.value):
+                    call = r.value
+                    dst = call.args[1] if len(call.args) > 1 else None
+                    sem = call.args[2] if len(call.args) > 2 else None
+                    _, dst_idx = _slot_index(dst) if dst is not None else (
+                        None, None)
+                    _, sem_idx = _slot_index(sem) if sem is not None else (
+                        None, None)
+                    _dst_expr, dst_k = _mod_k(dst_idx)
+                    _sem_expr, sem_k = _mod_k(sem_idx)
+                    mism = (
+                        dst_idx is not None and sem_idx is not None
+                        and ast.dump(dst_idx) != ast.dump(sem_idx)
+                    )
+                    fams[n.name] = _CopyFamily(
+                        n.name, n.lineno, dst_k, sem_k, mism
+                    )
+    return fams
+
+
+def _vmem_allocs(
+    tree: ast.Module,
+) -> List[Tuple[int, List[str], Optional[int]]]:
+    """Every ``pltpu.VMEM((dims...), dtype)`` allocation in the module:
+    (line, symbolic dim names, constant LEADING dim or None).  Only the
+    leading position can be a revolving slot count — a constant in a
+    trailing position is a tile width, not a slot declaration."""
+    out = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else ""
+        )
+        if name != "VMEM" or not n.args:
+            continue
+        dims = n.args[0]
+        if not isinstance(dims, ast.Tuple) or not dims.elts:
+            continue
+        syms = [e.id for e in dims.elts if isinstance(e, ast.Name)]
+        lead = dims.elts[0]
+        lead_k = (
+            lead.value
+            if isinstance(lead, ast.Constant) and isinstance(lead.value, int)
+            else None
+        )
+        out.append((n.lineno, syms, lead_k))
+    return out
+
+
+def _dma_sem_shapes(tree: ast.Module) -> Set[int]:
+    """Declared DMA semaphore slot counts:
+    ``pltpu.SemaphoreType.DMA((K,))``."""
+    out: Set[int] = set()
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        if not (isinstance(n.func, ast.Attribute)
+                and n.func.attr == "DMA"):
+            continue
+        if n.args and isinstance(n.args[0], ast.Tuple):
+            for e in n.args[0].elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.add(e.value)
+    return out
+
+
+def _budget_names(budget: ast.FunctionDef) -> Set[str]:
+    names = {a.arg for a in budget.args.args}
+    names |= {a.arg for a in budget.args.kwonlyargs}
+    for n in ast.walk(budget):
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+    return names
+
+
+def rule_rp07(tree: ast.Module, budget_fn: str) -> List[Tuple[int, str]]:
+    """DMA discipline over every kernel function in a module (see the
+    module docstring).  ``budget_fn`` names the module's VMEM budget
+    function for the allocation cross-check."""
+    out: List[Tuple[int, str]] = []
+
+    # -- budget cross-check (module-wide) --
+    budget = next(
+        (n for n in tree.body
+         if isinstance(n, _FUNC_NODES) and n.name == budget_fn), None
+    )
+    allocs = _vmem_allocs(tree)
+    if allocs and budget is None:
+        out.append((
+            allocs[0][0],
+            f"module allocates VMEM scratch but has no {budget_fn}() "
+            "budget function to charge it against",
+        ))
+    elif budget is not None:
+        names = _budget_names(budget)
+        for line, syms, _lead in allocs:
+            missing = sorted(s for s in syms if s not in names)
+            if missing:
+                out.append((
+                    line,
+                    "VMEM allocation dimension(s) "
+                    f"{', '.join(missing)} are not charged by the "
+                    f"{budget_fn}() budget — every VMEM operand the "
+                    "kernel allocates must appear in the budget "
+                    "expression",
+                ))
+
+    # leading constant dims of VMEM allocs (revolving slot counts live
+    # in the first position: VMEM((2, blk, cb), ...))
+    vmem_leads: Set[int] = {
+        lead for _, _syms, lead in allocs if lead is not None
+    }
+    dma_shapes = _dma_sem_shapes(tree)
+
+    # -- per-kernel flow checks --
+    for func in tree.body:
+        if not isinstance(func, _FUNC_NODES):
+            continue
+        if not any(isinstance(n, ast.Call) and _is_async_copy(n)
+                   for n in ast.walk(func)):
+            continue
+        fams = _collect_families(func)
+        cfg = build_cfg(func, pallas=True)
+
+        # named single-slot descriptors: x = pltpu.make_async_copy(...)
+        descriptors: Set[str] = set()
+        for node in cfg.nodes:
+            for sub in shallow_walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name) \
+                        and isinstance(sub.value, ast.Call) \
+                        and _is_async_copy(sub.value):
+                    name = sub.targets[0].id
+                    if name not in fams:
+                        fams[name] = _CopyFamily(
+                            name, sub.lineno, None, None, False
+                        )
+                    descriptors.add(name)
+
+        # events
+        for node in cfg.nodes:
+            for sub in shallow_walk(node):
+                if not (isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute)
+                        and sub.func.attr in ("start", "wait")):
+                    continue
+                recv = sub.func.value
+                fam = None
+                phase: Tuple[Optional[str], Optional[int]] = (None, None)
+                if isinstance(recv, ast.Call) and isinstance(
+                    recv.func, ast.Name
+                ) and recv.func.id in fams:
+                    fam = fams[recv.func.id]
+                    arg = recv.args[0] if recv.args else None
+                    phase = _affine(arg)
+                elif isinstance(recv, ast.Name) and recv.id in descriptors:
+                    fam = fams[recv.id]
+                elif isinstance(recv, ast.Call) and _is_async_copy(recv):
+                    # inline form: make_async_copy(...).start()/.wait()
+                    # with no helper and no bound name.  Family keyed by
+                    # the targeted buffer so a reconstructed-descriptor
+                    # wait (same buffer) still matches its start.
+                    dst = recv.args[1] if len(recv.args) > 1 else None
+                    buf_name, dst_idx = (
+                        _slot_index(dst) if dst is not None
+                        else (None, None)
+                    )
+                    expr, k = _mod_k(dst_idx)
+                    key = f"make_async_copy->{buf_name or '<dynamic>'}"
+                    fam = fams.get(key)
+                    if fam is None:
+                        fam = fams[key] = _CopyFamily(
+                            key, sub.lineno, k, None, False
+                        )
+                    phase = _affine(expr)
+                if fam is None:
+                    continue
+                ev = (node.idx, phase[0], phase[1], sub.lineno)
+                (fam.starts if sub.func.attr == "start"
+                 else fam.waits).append(ev)
+
+        for fam in fams.values():
+            if not fam.starts:
+                continue
+            if fam.idx_mismatch:
+                out.append((
+                    fam.line,
+                    f"{fam.name}: buffer and DMA semaphore revolve on "
+                    "different index expressions — copy and completion "
+                    "would track different slots",
+                ))
+            if fam.slot_k is not None and (
+                fam.slot_k not in vmem_leads or fam.slot_k not in dma_shapes
+            ):
+                out.append((
+                    fam.line,
+                    f"{fam.name}: revolving slot modulus % {fam.slot_k} "
+                    "does not match a declared slot count (VMEM leading "
+                    f"dims {sorted(vmem_leads) or 'none'}, DMA semaphore "
+                    f"shapes {sorted(dma_shapes) or 'none'})",
+                ))
+            if not fam.waits:
+                out.append((
+                    fam.starts[0][3],
+                    f"{fam.name}: make_async_copy started but never "
+                    "waited in this kernel — the DMA completes into a "
+                    "buffer nothing synchronizes on",
+                ))
+                continue
+            wait_nodes = {w[0] for w in fam.waits}
+            for node_idx, _base, _off, line in fam.starts:
+                if exit_reachable_without(cfg, node_idx, wait_nodes):
+                    out.append((
+                        line,
+                        f"{fam.name}: this start() can reach the kernel "
+                        "exit without a matching .wait() on some path — "
+                        "wait unconditionally (or under the same "
+                        "predicate as the start)",
+                    ))
+            # single-slot descriptors: a re-start before the wait
+            # overwrites an in-flight transfer
+            if fam.slot_k is None and len(fam.starts) >= 1:
+                start_nodes = {s[0] for s in fam.starts}
+                for node_idx, _b, _o, line in fam.starts:
+                    others = start_nodes  # incl. itself via the back edge
+                    if node_reachable_without(cfg, node_idx, others,
+                                              wait_nodes):
+                        out.append((
+                            line,
+                            f"{fam.name}: the copy can be re-started "
+                            "before its wait() (loop back-edge or "
+                            "sibling start) — a single-slot descriptor "
+                            "must complete before it is re-targeted",
+                        ))
+            # affine revolving-slot algebra
+            if fam.slot_k is not None:
+                K = fam.slot_k
+                loop_starts = [(b, c, ln) for _n, b, c, ln in fam.starts
+                               if b is not None]
+                prolog_starts = [(c, ln) for _n, b, c, ln in fam.starts
+                                 if b is None and c is not None]
+                loop_waits = [(b, c) for _n, b, c, _ln in fam.waits
+                              if b is not None]
+                wait_offs = {w for _b, w in loop_waits}
+                for base, c, line in loop_starts:
+                    offs = {w for b, w in loop_waits if b == base}
+                    if not offs:
+                        continue  # different induction base: no algebra
+                    if not any(0 <= c - w < K for w in offs):
+                        if any(c - w >= K for w in offs):
+                            out.append((
+                                line,
+                                f"{fam.name}: start at phase +{c} is "
+                                f"waited {min(c - w for w in offs)} "
+                                f"iterations later but only {K} slots "
+                                "revolve — the slot is re-targeted "
+                                "before its wait",
+                            ))
+                        else:
+                            out.append((
+                                line,
+                                f"{fam.name}: start at phase +{c} has "
+                                "no wait within its slot window "
+                                f"(wait phases {sorted(offs)}, {K} "
+                                "slots)",
+                            ))
+                for c, line in prolog_starts:
+                    # warm-up start at slot c is waited by wait(t+w) at
+                    # iteration c-w; legal while 0 <= c-w < K — a
+                    # multi-deep warm-up (slots 0..K-2) is correct, its
+                    # later slots are simply waited on later iterations
+                    if wait_offs and not any(
+                        0 <= c - w < K for w in wait_offs
+                    ):
+                        out.append((
+                            line,
+                            f"{fam.name}: warm-up start at slot "
+                            f"{c % K} is not waited within its slot "
+                            f"window (wait phases {sorted(wait_offs)}, "
+                            f"{K} slots) — the slot is re-targeted "
+                            "before any wait reaches it",
+                        ))
+                seen_mod: Dict[int, int] = {}
+                for base, c, line in loop_starts:
+                    prev = seen_mod.get(c % K)
+                    if prev is not None and prev != c:
+                        out.append((
+                            line,
+                            f"{fam.name}: two starts per iteration "
+                            f"target the same slot (phases +{prev} and "
+                            f"+{c} with {K} slots)",
+                        ))
+                    seen_mod.setdefault(c % K, c)
+    return out
+
+
+# -- RP08: thread/queue protocol ---------------------------------------------
+
+
+def _is_thread_call(call: ast.Call, thread_imported: bool) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return _dotted(f.value).split(".")[-1] == "threading"
+    return isinstance(f, ast.Name) and f.id == "Thread" and thread_imported
+
+
+def _contains_thread_call(node: ast.AST, thread_imported: bool) -> bool:
+    return any(
+        isinstance(n, ast.Call) and _is_thread_call(n, thread_imported)
+        for n in ast.walk(node)
+    )
+
+
+def _scopes(tree: ast.Module) -> List[ast.AST]:
+    return [n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)]
+
+
+def _name_escapes_scope(func: ast.AST, name: str) -> bool:
+    """The thread (or thread collection) bound to ``name`` leaves this
+    function — returned/yielded, stored on an object, or passed to a
+    call other than its own start/join — so join responsibility
+    escapes with it."""
+    for n in ast.walk(func):
+        if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = getattr(n, "value", None)
+            if v is not None and any(
+                isinstance(x, ast.Name) and x.id == name
+                for x in ast.walk(v)
+            ):
+                return True
+        elif isinstance(n, ast.Assign):
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in n.targets) and any(
+                isinstance(x, ast.Name) and x.id == name
+                for x in ast.walk(n.value)
+            ):
+                return True
+        elif isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr in (
+                "start", "join", "is_alive", "append",
+            ):
+                continue
+            for a in list(n.args) + [k.value for k in n.keywords]:
+                if any(isinstance(x, ast.Name) and x.id == name
+                       for x in ast.walk(a)):
+                    return True
+    return False
+
+
+def _rp08_function(func: ast.AST, thread_imported: bool,
+                   out: List[Tuple[int, str]]) -> None:
+    cfg = build_cfg(func)
+
+    # thread variables and collections (name -> contents for closure)
+    threads: Set[str] = set()
+    contents: Dict[str, Set[str]] = {}
+    for node in cfg.nodes:
+        for sub in shallow_walk(node):
+            # append-built pools: pool.append(t) makes pool a thread
+            # collection containing t (the canonical accumulate-then-
+            # join-in-finally idiom)
+            if isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute
+            ) and sub.func.attr == "append" and isinstance(
+                sub.func.value, ast.Name
+            ) and sub.args and isinstance(sub.args[0], ast.Name) and \
+                    sub.args[0].id in threads:
+                coll = sub.func.value.id
+                threads.add(coll)
+                contents.setdefault(coll, set()).add(sub.args[0].id)
+                continue
+            if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)):
+                continue
+            tgt = sub.targets[0].id
+            v = sub.value
+            if isinstance(v, ast.Call) and _is_thread_call(
+                v, thread_imported
+            ):
+                threads.add(tgt)
+            elif isinstance(v, (ast.ListComp, ast.GeneratorExp)) and \
+                    _contains_thread_call(v, thread_imported):
+                threads.add(tgt)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                inner: Set[str] = set()
+                for e in v.elts:
+                    if isinstance(e, ast.Starred) and isinstance(
+                        e.value, ast.Name
+                    ):
+                        inner.add(e.value.id)
+                    elif isinstance(e, ast.Name):
+                        inner.add(e.id)
+                if inner & threads or any(i in contents for i in inner):
+                    threads.add(tgt)
+                    contents[tgt] = inner
+    if not threads:
+        return
+
+    def covers(join_target: str) -> Set[str]:
+        seen = {join_target}
+        stack = [join_target]
+        while stack:
+            t = stack.pop()
+            for c in contents.get(t, ()):
+                if c not in seen:
+                    seen.add(c)
+                    stack.append(c)
+        return seen
+
+    # events: direct x.start()/x.join(), and for-loops iterating a
+    # thread collection whose body starts/joins the loop variable (the
+    # event is the loop header: a zero-trip loop means zero threads, so
+    # the header IS the collection-wide event)
+    starts: List[Tuple[int, str, int]] = []   # (node, target, line)
+    joins: List[Tuple[int, str]] = []         # (node, target)
+    for node in cfg.nodes:
+        stmt = node.stmt
+        if node.kind == "loop" and isinstance(stmt, ast.For) and \
+                isinstance(stmt.iter, ast.Name) and isinstance(
+                    stmt.target, ast.Name) and stmt.iter.id in threads:
+            lv, coll = stmt.target.id, stmt.iter.id
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ) and isinstance(sub.func.value, ast.Name) and \
+                        sub.func.value.id == lv:
+                    if sub.func.attr == "start":
+                        starts.append((node.idx, coll, stmt.lineno))
+                    elif sub.func.attr == "join":
+                        joins.append((node.idx, coll))
+            continue
+        for sub in shallow_walk(node):
+            if isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute
+            ) and isinstance(sub.func.value, ast.Name) and \
+                    sub.func.value.id in threads:
+                if sub.func.attr == "start":
+                    starts.append((node.idx, sub.func.value.id, sub.lineno))
+                elif sub.func.attr == "join":
+                    joins.append((node.idx, sub.func.value.id))
+
+    for node_idx, target, line in starts:
+        if _name_escapes_scope(func, target):
+            continue  # ownership (and join duty) left this function
+        join_nodes = {n for n, jt in joins if target in covers(jt)}
+        if not join_nodes:
+            out.append((
+                line,
+                f"thread {target!r} is started but never joined in "
+                "this function (and does not escape it) — join it on "
+                "the shutdown path, bounded",
+            ))
+        elif exit_reachable_without(cfg, node_idx, join_nodes):
+            out.append((
+                line,
+                f"thread {target!r} is not joined on every path from "
+                "its start() to the function exit (an early return, "
+                "break or raise path skips the join) — join in a "
+                "finally",
+            ))
+
+
+_CLOSE_METHODS = ("close", "shutdown", "stop", "__exit__", "__del__")
+_CLOSED_GUARD_MARKERS = ("closed", "stop", "shutdown", "done")
+
+
+def _rp08_class(cls: ast.ClassDef, thread_imported: bool,
+                out: List[Tuple[int, str]]) -> None:
+    # attribute-held threads: self.X = threading.Thread(...)
+    attr_threads: Dict[str, int] = {}
+    for n in ast.walk(cls):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Attribute) and isinstance(
+                    n.targets[0].value, ast.Name) and \
+                n.targets[0].value.id == "self" and isinstance(
+                    n.value, ast.Call) and _is_thread_call(
+                    n.value, thread_imported):
+            attr_threads[n.targets[0].attr] = n.lineno
+    methods = {m.name: m for m in cls.body if isinstance(m, _FUNC_NODES)}
+    close_like = [methods[m] for m in _CLOSE_METHODS if m in methods]
+
+    def attr_calls(scope: ast.AST, attr: str) -> Set[str]:
+        return {
+            n.func.attr
+            for n in ast.walk(scope)
+            if isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute)
+            and isinstance(n.func.value, ast.Attribute)
+            and n.func.value.attr == attr
+            and isinstance(n.func.value.value, ast.Name)
+            and n.func.value.value.id == "self"
+        }
+
+    for attr, line in attr_threads.items():
+        if "start" not in attr_calls(cls, attr):
+            continue
+        if "join" not in attr_calls(cls, attr):
+            out.append((
+                line,
+                f"self.{attr} thread is started but the class never "
+                f"joins it — a shutdown path (one of "
+                f"{'/'.join(_CLOSE_METHODS[:3])}) must join",
+            ))
+            continue
+        if close_like:
+            reach = list(close_like)
+            # one level of self-method calls from the close-like methods
+            for m in close_like:
+                for n in ast.walk(m):
+                    if isinstance(n, ast.Call) and isinstance(
+                        n.func, ast.Attribute
+                    ) and isinstance(n.func.value, ast.Name) and \
+                            n.func.value.id == "self" and \
+                            n.func.attr in methods:
+                        reach.append(methods[n.func.attr])
+            if not any("join" in attr_calls(m, attr) for m in reach):
+                out.append((
+                    line,
+                    f"self.{attr} thread's join is not reachable from "
+                    f"the class's close-like method(s) — the shutdown "
+                    "path never waits for the thread",
+                ))
+
+    # shutdown sentinel: enqueued unconditionally from close()
+    sentinels = {
+        n.targets[0].id
+        for n in cls.body
+        if isinstance(n, ast.Assign) and len(n.targets) == 1
+        and isinstance(n.targets[0], ast.Name)
+        and isinstance(n.value, ast.Call)
+        and isinstance(n.value.func, ast.Name)
+        and n.value.func.id == "object"
+    }
+    if not sentinels:
+        return
+    close = next((methods[m] for m in ("close", "shutdown", "stop")
+                  if m in methods), None)
+    if close is None:
+        return
+    cfg = build_cfg(close)
+    put_nodes: Set[int] = set()
+    for node in cfg.nodes:
+        for sub in shallow_walk(node):
+            if isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute
+            ) and sub.func.attr in ("put", "put_nowait"):
+                refs = any(
+                    isinstance(x, ast.Attribute) and x.attr in sentinels
+                    for a in sub.args for x in ast.walk(a)
+                )
+                if refs:
+                    put_nodes.add(node.idx)
+    if not put_nodes:
+        out.append((
+            close.lineno,
+            f"{close.name}() never enqueues the shutdown sentinel "
+            f"({'/'.join(sorted(sentinels))}) — the dispatcher is never "
+            "told to drain and stop",
+        ))
+        return
+    # exits that skip the put must be idempotence guards (a return
+    # governed by a closed/stopped-flag test), nothing else
+    allowed_exits: Set[int] = set()
+    for node in cfg.nodes:
+        if isinstance(node.stmt, ast.Return) and node.kind == "stmt":
+            if any(pol and any(m in dump.lower()
+                               for m in _CLOSED_GUARD_MARKERS)
+                   for dump, pol in node.facts):
+                allowed_exits.add(node.idx)
+    if exit_reachable_without(cfg, cfg.entry, put_nodes | allowed_exits,
+                              frozenset()):
+        out.append((
+            close.lineno,
+            f"{close.name}() can exit without enqueueing the shutdown "
+            "sentinel on a path that is not a closed-flag guard — the "
+            "sentinel enqueue must be unconditional",
+        ))
+
+
+def _rp08_ack_after_yield(func: ast.AST,
+                          out: List[Tuple[int, str]]) -> None:
+    cfg = build_cfg(func)
+    commits: List[int] = []
+    yields: List[int] = []
+    for node in cfg.nodes:
+        for sub in shallow_walk(node):
+            if isinstance(sub, ast.Assign) and any(
+                isinstance(t, ast.Attribute) and t.attr == "rows_done"
+                for t in sub.targets
+            ):
+                commits.append(node.idx)
+            elif isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                yields.append(node.idx)
+    if not commits or not yields:
+        return
+    dom = dominators(cfg)
+    for c in commits:
+        if any(c in dom[y] for y in yields):
+            out.append((
+                cfg.nodes[c].stmt.lineno,
+                "cursor commit dominates its batch's yield — the "
+                "cursor advances before the consumer has acknowledged "
+                "the batch (ack-after-yield contract): a crash in the "
+                "consumer would silently drop the row range on resume",
+            ))
+
+
+def rule_rp08(tree: ast.Module) -> List[Tuple[int, str]]:
+    """Thread/queue protocol over one module (see module docstring)."""
+    out: List[Tuple[int, str]] = []
+    thread_imported = any(
+        isinstance(n, ast.ImportFrom) and n.module
+        and n.module.endswith("threading")
+        and any(a.name == "Thread" for a in n.names)
+        for n in ast.walk(tree)
+    )
+    for func in _scopes(tree):
+        _rp08_function(func, thread_imported, out)
+        _rp08_ack_after_yield(func, out)
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ClassDef):
+            _rp08_class(n, thread_imported, out)
+    return out
+
+
+# -- RP09: interprocedural host-sync -----------------------------------------
+
+
+def _own_nodes(scope: ast.AST):
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _FUNC_NODES + (ast.Lambda,)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _callee_syncs(callee: ast.AST, suppressed: Dict[int, Set[str]]
+                  ) -> List[Tuple[int, str]]:
+    """Unsuppressed host syncs lexically owned by ``callee`` (nested
+    defs excluded: they do not run when the callee does)."""
+    out = []
+    for n in _own_nodes(callee):
+        if isinstance(n, ast.Call):
+            what = host_sync_what(n)
+            if what is None:
+                continue
+            rules = suppressed.get(n.lineno, set()) | suppressed.get(
+                n.lineno - 1, set()
+            )
+            if "RP03" in rules or "RP09" in rules:
+                continue  # the owning file already justified this sync
+            out.append((n.lineno, what))
+    return out
+
+
+def rule_rp09(tree: ast.Module, relpath: str,
+              index: Optional[PackageIndex] = None,
+              suppressed: Optional[Dict[int, Set[str]]] = None
+              ) -> List[Tuple[int, str]]:
+    """Interprocedural host-sync: loop bodies in a hot module calling
+    (one level of) package functions that perform a host sync.  The
+    finding anchors at the call site — that is where the hot loop pays
+    the stall, and where a pragma belongs if the overlap is real.  A
+    caller-provided ``index`` is never mutated; its entry for this
+    module (same source, indexed once by ``lint_package``) is reused."""
+    idx = index if index is not None else PackageIndex()
+    self_info = idx.modules.get(relpath) if index is not None else None
+    if self_info is None:
+        self_info = index_module(relpath, tree, suppressed)
+    parents = _parents_map(tree)
+
+    def enclosing(node: ast.AST, kinds) -> Optional[ast.AST]:
+        p = parents.get(node)
+        while p is not None and not isinstance(p, kinds):
+            p = parents.get(p)
+        return p
+
+    out: List[Tuple[int, str]] = []
+    seen: Set[Tuple[int, str]] = set()
+    loops = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+    for loop in loops:
+        encl_func = enclosing(loop, _FUNC_NODES)
+        cls = enclosing(loop, (ast.ClassDef,))
+        cls_name = cls.name if cls is not None else None
+        for n in ast.walk(loop):
+            if not isinstance(n, ast.Call) or host_sync_what(n) is not None:
+                continue  # direct syncs are RP03's finding, not RP09's
+            resolved = idx.resolve(n, self_info, cls_name)
+            if resolved is None:
+                continue
+            owner, callee, display = resolved
+            if callee is encl_func:
+                continue  # recursion: the loop IS the callee
+            syncs = _callee_syncs(callee, owner.suppressed)
+            if not syncs:
+                continue
+            key = (n.lineno, display)
+            if key in seen:
+                continue
+            seen.add(key)
+            sline, what = syncs[0]
+            where = (f"{owner.relpath}:{sline}"
+                     if owner.relpath != relpath else f"line {sline}")
+            out.append((
+                n.lineno,
+                f"call to {display}() inside a hot-module loop reaches "
+                f"a host sync ({what} at {where}) — the helper blocks "
+                "the loop on d2h every iteration; overlap the fetch or "
+                "hoist the call",
+            ))
+    return out

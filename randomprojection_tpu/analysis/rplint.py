@@ -1,9 +1,14 @@
 """rplint — AST-based invariant checker for this repo's contracts.
 
-Generic linters cannot see the contracts the r6–r9 pipeline work relies
+Generic linters cannot see the contracts the r6–r14 pipeline work relies
 on (a ``start_span`` with no exception-safe ``end_span`` is legal
-Python; an unbounded ``queue.Queue()`` is idiomatic); this checker
-encodes them as project rules over the stdlib ``ast``:
+Python; an unbounded ``queue.Queue()`` is idiomatic; an un-waited
+``make_async_copy`` compiles fine); this checker encodes them as
+project rules over the stdlib ``ast``.  Since ISSUE 11 the checker is a
+small *flow-sensitive* framework: a shared CFG/call-graph substrate
+(``analysis/cfg.py``) feeds the path-sensitive rules
+(``analysis/flowrules.py``) while the per-line rules keep their r10
+shape.
 
 - **RP01 span-balance** — a ``start_span`` whose handle neither escapes
   its function (returned / yielded / stored / passed on, e.g. through a
@@ -14,9 +19,14 @@ encodes them as project rules over the stdlib ``ast``:
   allocation and corrupt trace reconstruction.
 - **RP02 event-registry drift** — every statically-resolvable event
   name passed to ``emit()`` must be a member of ``telemetry.EVENTS``
-  (f-string names must extend a registered ``FAMILIES`` prefix), and
-  every registry member must be either consumed by
-  ``utils/trace_report.py`` or documented in docs/ARCHITECTURE.md.
+  (f-string and ``FAMILY``-constant-anchored names must extend a
+  registered ``FAMILIES`` prefix), and every registry member must be
+  either consumed by ``utils/trace_report.py`` or documented in
+  docs/ARCHITECTURE.md.  Names built dynamically (a variable, an
+  unanchored concatenation, a ``.format()``) are reported as
+  ``unresolvable-emit`` *informational* findings — they never fail the
+  lint, but ``--json`` counts them so registry coverage is honest about
+  its blind spot.
 - **RP03 host-sync-in-hot-path** — inside loop bodies of the hot
   modules (``HOT_MODULES``), no ``np.asarray``, ``.block_until_ready``,
   ``jax.device_get`` or ``float()``-on-expression: a per-iteration host
@@ -33,22 +43,56 @@ encodes them as project rules over the stdlib ``ast``:
 - **RP06 silent-swallow** — broad ``except`` handlers (bare /
   ``Exception`` / ``BaseException``) in the pipeline/serving modules
   must re-raise, emit telemetry, or close the active span.
+- **RP07 DMA discipline** (flow-sensitive; kernel modules) — inside
+  Pallas kernel bodies, every ``make_async_copy`` start must reach a
+  matching ``.wait()`` on all paths (``@pl.when`` bodies and
+  ``fori_loop`` body functions are spliced into the CFG); revolving
+  slot phases must stay within the declared slot count (a start at
+  phase ``+c`` waited at phase ``+w`` re-targets its buffer after ``K``
+  iterations, so ``0 <= c-w < K``); the revolving modulus must match a
+  declared ``VMEM``/DMA-semaphore slot count; and the module's VMEM
+  budget function (``_reserved_bytes`` / ``plan_fused``) must charge
+  every VMEM operand the kernels actually allocate (re-derived from the
+  AST).
+- **RP08 thread/queue protocol** (flow-sensitive) — every thread
+  started in a function is joined on *every* path out of it (early
+  returns, raises, try/finally modeled); threads stored on ``self`` are
+  joined by the class, reachably from its close-like method; a
+  shutdown-sentinel enqueue is unconditionally reachable from
+  ``close()`` (only closed-flag idempotence guards may skip it); and no
+  cursor commit dominates its batch's ``yield`` (ack-after-yield).
+- **RP09 interprocedural host-sync** (hot modules) — RP03 one call
+  deeper: a loop-body call resolved one level through the package
+  (same-module defs, ``self.`` methods, ``from randomprojection_tpu...
+  import`` names) whose callee performs an unsuppressed host sync is
+  reported at the call site — the helper-hidden stall r9 fixed by hand.
 
-Suppression pragma (same line as the finding, or the line directly
-above it)::
+Suppression pragma (same line as the finding, the line directly above
+it, or any physical line of the same logical statement — so pragmas on
+continuation lines work)::
 
     # rplint: allow[RP03] — d2h already started at dispatch
     # rplint: allow[RP04,RP06] — reason covering both rules
 
 The reason is mandatory; a pragma that does not parse, names an unknown
 rule, or omits the reason is itself reported (RP00) and suppresses
-nothing.  ``main()`` exits non-zero on any unsuppressed finding;
-``--json`` emits the stable findings schema (``rplint`` version, rule
-id, path, line, message, pragma state) for the bench/record machinery.
+nothing.  A well-formed pragma that suppresses *nothing* — because the
+code it excused has been edited away — is reported as a **stale
+pragma** (RP00) when every rule it names was actually evaluated for
+the file, so dead suppressions cannot accumulate.
 
-The analysis is intraprocedural and syntactic by design — it prefers
-missing an exotic violation over flagging correct code, because every
-false positive costs a pragma in the tree forever.
+Exit codes (``cli lint`` inherits them): **0** no unsuppressed finding,
+**1** findings, **2** internal error (unreadable input, malformed
+baseline, analysis crash) — a partial run can never report success.
+``--json`` emits the stable findings schema (``rplint`` version, rule
+id, path, line, message, severity, pragma state) for the bench/record
+machinery.  ``--baseline <json>`` diffs against a prior ``--json``
+record and fails only on NEW findings (matched on rule+path+message, so
+line drift never re-flags a baselined finding) — strict rules can land
+without blocking unrelated work.
+
+The analysis prefers missing an exotic violation over flagging correct
+code, because every false positive costs a pragma in the tree forever.
 """
 
 from __future__ import annotations
@@ -60,8 +104,17 @@ import io
 import json
 import os
 import re
+import sys
 import tokenize
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from randomprojection_tpu.analysis import flowrules
+from randomprojection_tpu.analysis.cfg import (
+    PackageIndex,
+    dotted as _dotted,
+    index_module,
+    parents_map as _parents,
+)
 
 __all__ = [
     "RULES",
@@ -69,6 +122,7 @@ __all__ = [
     "EventRegistry",
     "load_event_registry",
     "check_registry_drift",
+    "diff_baseline",
     "lint_source",
     "lint_package",
     "package_root",
@@ -78,13 +132,15 @@ __all__ = [
 RULES = {
     "RP00": "pragma hygiene: rplint pragmas parse as "
             "`# rplint: allow[RPxx] — <reason>` with known rules and a "
-            "reason",
+            "reason, and a pragma that suppresses nothing is stale",
     "RP01": "span-balance: start_span handles escape or end in a "
             "finally/except; span_* events are emitted only by "
             "utils/telemetry.py",
     "RP02": "event-registry drift: emitted event names live in "
             "telemetry.EVENTS, and every registry entry is consumed by "
-            "trace_report.py or documented in ARCHITECTURE.md",
+            "trace_report.py or documented in ARCHITECTURE.md "
+            "(dynamically-built names are counted as unresolvable-emit "
+            "informational findings)",
     "RP03": "host-sync-in-hot-path: no np.asarray / .block_until_ready / "
             "jax.device_get / float()-on-expression inside loop bodies of "
             "the hot modules",
@@ -94,6 +150,15 @@ RULES = {
             "np.random.<fn> inside ops/",
     "RP06": "silent-swallow: broad except handlers in pipeline modules "
             "re-raise, emit telemetry, or close the span",
+    "RP07": "DMA discipline: every make_async_copy start reaches a wait "
+            "on all paths, revolving slots stay within the declared slot "
+            "count, and the kernel VMEM budget charges every VMEM "
+            "allocation",
+    "RP08": "thread/queue protocol: threads join on every shutdown path, "
+            "close() reaches the shutdown sentinel unconditionally, and "
+            "no cursor commit dominates its batch's yield",
+    "RP09": "interprocedural host-sync: hot-module loops must not call a "
+            "package helper (one level deep) that performs a host sync",
 }
 
 # -- rule scoping (paths are package-relative, '/'-separated) ----------------
@@ -101,7 +166,7 @@ RULES = {
 TELEMETRY_MODULE = "utils/telemetry.py"
 TRACE_REPORT_MODULE = "utils/trace_report.py"
 ARCHITECTURE_DOC = os.path.join("docs", "ARCHITECTURE.md")
-# RP03: the modules whose loops are the streamed/serving hot sections
+# RP03/RP09: the modules whose loops are the streamed/serving hot sections
 HOT_MODULES = (
     "streaming.py",
     "backends/jax_backend.py",
@@ -125,6 +190,13 @@ PIPELINE_MODULES = HOT_MODULES + (
     TRACE_REPORT_MODULE,
 )
 DETERMINISM_PREFIXES = ("ops/",)
+# RP07: the manually-DMA'd Pallas kernel modules, each with the function
+# that owns its scoped-VMEM budget (the allocation cross-check target)
+KERNEL_BUDGET_FNS = {
+    "ops/pallas_kernels.py": "_reserved_bytes",
+    "ops/topk_kernels.py": "plan_fused",
+}
+KERNEL_MODULES = tuple(KERNEL_BUDGET_FNS)
 # RP05: Generator-construction surface of np.random that stays legal
 RNG_FACTORY_OK = frozenset(
     {"default_rng", "Generator", "SeedSequence", "PCG64", "Philox",
@@ -146,7 +218,10 @@ _ALLOW_RE = re.compile(
 @dataclasses.dataclass
 class Finding:
     """One lint finding; ``suppressed`` marks a pragma'd (accepted)
-    violation, ``reason`` carries the pragma's justification."""
+    violation, ``reason`` carries the pragma's justification,
+    ``severity`` is ``"error"`` (fails the lint) or ``"info"``
+    (reported and counted, never fatal — the unresolvable-emit
+    class)."""
 
     rule: str
     path: str
@@ -154,6 +229,7 @@ class Finding:
     message: str
     suppressed: bool = False
     reason: str = ""
+    severity: str = "error"
 
     def to_dict(self) -> dict:
         return {
@@ -163,34 +239,52 @@ class Finding:
             "message": self.message,
             "suppressed": self.suppressed,
             "reason": self.reason,
+            "severity": self.severity,
         }
 
     def render(self) -> str:
         sup = "  [suppressed: %s]" % self.reason if self.suppressed else ""
-        return f"{self.path}:{self.line}: {self.rule} {self.message}{sup}"
+        sev = " (info)" if self.severity == "info" else ""
+        return f"{self.path}:{self.line}: {self.rule}{sev} {self.message}{sup}"
 
 
 # -- pragma scanning ---------------------------------------------------------
 
 
+@dataclasses.dataclass
+class _Pragma:
+    """One well-formed allow pragma: the comment's physical line, the
+    rules it names, the mandatory reason, and whether it ended up
+    suppressing anything (stale detection)."""
+
+    line: int
+    rules: Set[str]
+    reason: str
+    matched: bool = False
+
+
 def _scan_pragmas(
     src: str, relpath: str
-) -> Tuple[Dict[int, Tuple[set, str]], List[Finding]]:
-    """``{line: (rules, reason)}`` for every well-formed allow pragma,
-    plus RP00 findings for malformed ones.  Comment tokens only — a
-    pragma-shaped string literal is never a pragma."""
-    allows: Dict[int, Tuple[set, str]] = {}
+) -> Tuple[Dict[int, List[_Pragma]], List[Finding], List[_Pragma]]:
+    """``{physical line: [pragmas attached there]}`` plus RP00 findings
+    for malformed pragmas and the flat pragma list (for stale
+    detection).  Comment tokens only — a pragma-shaped string literal
+    is never a pragma.  A pragma on any physical line of a multi-line
+    logical statement attaches to every line of that statement, so
+    findings anchored at the statement's first line are suppressible
+    from a continuation line."""
+    allows: Dict[int, List[_Pragma]] = {}
+    pragmas: List[_Pragma] = []
     findings: List[Finding] = []
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
     except (tokenize.TokenError, IndentationError):  # pragma: no cover
-        return allows, findings  # ast.parse already reported the syntax
-    for tok in tokens:
-        if tok.type != tokenize.COMMENT:
-            continue
+        return allows, findings, pragmas  # ast.parse reported the syntax
+
+    def parse(tok) -> Optional[_Pragma]:
         m = _PRAGMA_RE.search(tok.string)
         if m is None:
-            continue
+            return None
         line = tok.start[0]
         am = _ALLOW_RE.match(m.group(1).strip())
         if am is None:
@@ -199,7 +293,7 @@ def _scan_pragmas(
                 "unparseable rplint pragma (grammar: "
                 "`# rplint: allow[RPxx] — <reason>`, reason required)",
             ))
-            continue
+            return None
         rules = {r.strip().upper() for r in am.group(1).split(",")
                  if r.strip()}
         unknown = sorted(rules - set(RULES))
@@ -212,28 +306,47 @@ def _scan_pragmas(
                 f"pragma names unknown rule(s): {', '.join(unknown)} — "
                 "the pragma suppresses nothing",
             ))
+            return None
+        if not rules:
+            return None
+        return _Pragma(line, rules, am.group(2).strip())
+
+    def register(p: _Pragma, lines) -> None:
+        for ln in lines:
+            lst = allows.setdefault(ln, [])
+            if p not in lst:
+                lst.append(p)
+
+    span_start: Optional[int] = None
+    pending: List[_Pragma] = []
+    for tok in tokens:
+        tt = tok.type
+        if tt == tokenize.COMMENT:
+            p = parse(tok)
+            if p is not None:
+                pragmas.append(p)
+                register(p, [p.line])
+                if span_start is not None:
+                    pending.append(p)
             continue
-        if rules:
-            prev = allows.get(line)
-            if prev is not None:
-                rules |= prev[0]
-            allows[line] = (rules, am.group(2).strip())
-    return allows, findings
+        if tt == tokenize.NEWLINE:
+            if span_start is not None and pending:
+                # logical line ends: a pragma anywhere in it covers the
+                # whole statement's physical span
+                for p in pending:
+                    register(p, range(span_start, tok.start[0] + 1))
+            span_start = None
+            pending = []
+            continue
+        if tt in (tokenize.NL, tokenize.INDENT, tokenize.DEDENT,
+                  tokenize.ENDMARKER):
+            continue
+        if span_start is None:
+            span_start = tok.start[0]
+    return allows, findings, pragmas
 
 
 # -- small AST helpers -------------------------------------------------------
-
-
-def _dotted(node: ast.AST) -> str:
-    """Dotted-name string of a Name/Attribute chain ('' when dynamic)."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
 
 
 def _callee(call: ast.Call) -> str:
@@ -244,14 +357,6 @@ def _callee(call: ast.Call) -> str:
     if isinstance(f, ast.Name):
         return f.id
     return ""
-
-
-def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
-    return {
-        child: parent
-        for parent in ast.walk(tree)
-        for child in ast.iter_child_nodes(parent)
-    }
 
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -310,12 +415,16 @@ def _is_emit_call(call: ast.Call, *, in_telemetry: bool,
 @dataclasses.dataclass
 class EventRegistry:
     """Statically-parsed view of ``telemetry.EVENTS``: constant name →
-    event string (families excluded), family prefixes, and the source
-    line of each constant (so drift findings anchor to the registry)."""
+    event string (families excluded), family prefixes, the source line
+    of each constant (so drift findings anchor to the registry), and
+    the family constant names (``*_FAMILY``) so a
+    ``EVENTS.X_FAMILY + suffix`` concatenation resolves as a family
+    extension."""
 
     events: Dict[str, str]
     families: Tuple[str, ...]
     lines: Dict[str, int]
+    family_attrs: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def knows(self, name: str) -> bool:
         return name in self.events.values() or any(
@@ -340,6 +449,7 @@ def load_event_registry(telemetry_src: str) -> Optional[EventRegistry]:
     events: Dict[str, str] = {}
     lines: Dict[str, int] = {}
     families: List[str] = []
+    family_attrs: Dict[str, str] = {}
     for stmt in cls.body:
         if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
                 and isinstance(stmt.targets[0], ast.Name)):
@@ -356,10 +466,12 @@ def load_event_registry(telemetry_src: str) -> Optional[EventRegistry]:
             continue
         if attr.endswith("_FAMILY"):
             families.append(stmt.value.value)
+            family_attrs[attr] = stmt.value.value
             continue
         events[attr] = stmt.value.value
         lines[attr] = stmt.lineno
-    return EventRegistry(events, tuple(dict.fromkeys(families)), lines)
+    return EventRegistry(events, tuple(dict.fromkeys(families)), lines,
+                         family_attrs)
 
 
 def check_registry_drift(
@@ -514,6 +626,16 @@ def _rule_rp02(tree: ast.Module, relpath: str,
         return []
     out: List[Finding] = []
     in_telemetry = relpath == TELEMETRY_MODULE
+
+    def unresolvable(call: ast.Call, kind: str) -> Finding:
+        return Finding(
+            "RP02", relpath, call.lineno,
+            f"unresolvable-emit: event name built dynamically ({kind}) "
+            "— not statically checkable against telemetry.EVENTS; "
+            "prefer an EVENTS constant or a FAMILY-anchored name",
+            severity="info",
+        )
+
     for call in (n for n in ast.walk(tree) if isinstance(n, ast.Call)):
         if not _is_emit_call(call, in_telemetry=in_telemetry,
                              emit_imported=emit_imported):
@@ -530,13 +652,18 @@ def _rule_rp02(tree: ast.Module, relpath: str,
         elif isinstance(a0, ast.Attribute):
             base = _dotted(a0.value)
             if base == "EVENTS" or base.endswith(".EVENTS"):
-                if a0.attr not in registry.events:
+                if a0.attr not in registry.events and (
+                    a0.attr not in registry.family_attrs
+                ):
                     out.append(Finding(
                         "RP02", relpath, call.lineno,
                         f"emit references unknown registry constant "
                         f"EVENTS.{a0.attr}",
                     ))
-            # other attributes (a variable's field) are dynamic: skip
+            else:
+                # some other object's attribute: a dynamic name (was
+                # silently skipped before ISSUE 11 — now counted)
+                out.append(unresolvable(call, "attribute of a variable"))
         elif isinstance(a0, ast.JoinedStr):
             prefix = ""
             for part in a0.values:
@@ -552,10 +679,34 @@ def _rule_rp02(tree: ast.Module, relpath: str,
                     f"f-string event name (static prefix {prefix!r}) does "
                     "not extend any registered EVENTS.FAMILIES prefix",
                 ))
+        elif isinstance(a0, ast.BinOp) and isinstance(a0.op, ast.Add):
+            left = a0.left
+            l_base = _dotted(left.value) if isinstance(
+                left, ast.Attribute) else ""
+            if isinstance(left, ast.Attribute) and (
+                l_base == "EVENTS" or l_base.endswith(".EVENTS")
+            ) and left.attr in registry.family_attrs:
+                pass  # EVENTS.<X>_FAMILY + suffix: a family extension
+            elif isinstance(left, ast.Constant) and isinstance(
+                left.value, str
+            ):
+                if not any(left.value.startswith(f)
+                           for f in registry.families):
+                    out.append(Finding(
+                        "RP02", relpath, call.lineno,
+                        f"concatenated event name (static prefix "
+                        f"{left.value!r}) does not extend any registered "
+                        "EVENTS.FAMILIES prefix",
+                    ))
+            else:
+                out.append(unresolvable(call, "string concatenation"))
+        elif a0 is not None:
+            kind = type(a0).__name__
+            out.append(unresolvable(
+                call, {"Name": "a variable", "Call": "a call result"}.get(
+                    kind, kind)
+            ))
     return out
-
-
-_HOST_SYNCS = {"asarray": ("np", "numpy"), "device_get": ("jax",)}
 
 
 def _rule_rp03(tree: ast.Module, relpath: str) -> List[Finding]:
@@ -569,21 +720,7 @@ def _rule_rp03(tree: ast.Module, relpath: str) -> List[Finding]:
         for n in ast.walk(loop):
             if not isinstance(n, ast.Call) or id(n) in seen:
                 continue
-            f = n.func
-            what = None
-            if isinstance(f, ast.Attribute):
-                bases = _HOST_SYNCS.get(f.attr)
-                if bases and isinstance(f.value, ast.Name) and (
-                    f.value.id in bases
-                ):
-                    what = f"{f.value.id}.{f.attr}"
-                elif f.attr == "block_until_ready":
-                    what = ".block_until_ready()"
-            elif isinstance(f, ast.Name) and f.id == "float" and n.args:
-                # float(scalar_name) is fine; float(<expression>) on an
-                # array element/reduction forces a device sync
-                if not isinstance(n.args[0], (ast.Name, ast.Constant)):
-                    what = "float() on an expression"
+            what = flowrules.host_sync_what(n)
             if what is not None:
                 seen.add(id(n))
                 out.append(Finding(
@@ -739,38 +876,90 @@ def _rule_rp06(tree: ast.Module, relpath: str) -> List[Finding]:
 
 
 def lint_source(src: str, relpath: str, *,
-                registry: Optional[EventRegistry] = None) -> List[Finding]:
+                registry: Optional[EventRegistry] = None,
+                index: Optional[PackageIndex] = None,
+                tree: Optional[ast.Module] = None) -> List[Finding]:
     """Lint one module's source.  ``relpath`` is the package-relative
     path ('/'-separated) the rule scoping keys on; tests lint fixture
-    text under virtual relpaths to exercise module-scoped rules."""
+    text under virtual relpaths to exercise module-scoped rules.
+    ``index`` (built by ``lint_package``) enables RP09's cross-module
+    call resolution; without it RP09 resolves same-file calls only.
+    ``tree`` is an optional pre-parsed AST of ``src`` (``lint_package``
+    passes the one it already built for the index, so targets parse
+    once per run)."""
     relpath = relpath.replace(os.sep, "/")
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [Finding(
-            "RP00", relpath, e.lineno or 1, f"syntax error: {e.msg}"
-        )]
-    allows, findings = _scan_pragmas(src, relpath)
+    if tree is None:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            return [Finding(
+                "RP00", relpath, e.lineno or 1, f"syntax error: {e.msg}"
+            )]
+    allows, findings, pragmas = _scan_pragmas(src, relpath)
     parents = _parents(tree)
     emit_imported = _imports_name(tree, "telemetry", "emit")
+    # rules actually evaluated for this file — a pragma naming only
+    # rules that never ran here cannot be judged stale
+    evaluated: Set[str] = {"RP01", "RP04", "RP08"}
     findings += _rule_rp01(tree, relpath, parents, emit_imported)
+    if registry is not None:
+        evaluated.add("RP02")
     findings += _rule_rp02(tree, relpath, registry, emit_imported)
     if relpath in HOT_MODULES:
+        evaluated.add("RP03")
         findings += _rule_rp03(tree, relpath)
     findings += _rule_rp04(tree, relpath)
     if relpath.startswith(DETERMINISM_PREFIXES):
+        evaluated.add("RP05")
         findings += _rule_rp05(tree, relpath)
     if relpath in PIPELINE_MODULES:
+        evaluated.add("RP06")
         findings += _rule_rp06(tree, relpath)
+    if relpath in KERNEL_MODULES:
+        evaluated.add("RP07")
+        findings += [
+            Finding("RP07", relpath, ln, msg)
+            for ln, msg in flowrules.rule_rp07(
+                tree, KERNEL_BUDGET_FNS[relpath]
+            )
+        ]
+    findings += [
+        Finding("RP08", relpath, ln, msg)
+        for ln, msg in flowrules.rule_rp08(tree)
+    ]
+    if relpath in HOT_MODULES:
+        evaluated.add("RP09")
+        sup = {
+            ln: set().union(*(p.rules for p in ps))
+            for ln, ps in allows.items()
+        }
+        findings += [
+            Finding("RP09", relpath, ln, msg)
+            for ln, msg in flowrules.rule_rp09(
+                tree, relpath, index=index, suppressed=sup
+            )
+        ]
     for f in findings:
-        if f.rule == "RP00":
-            continue  # pragma hygiene is not itself suppressible
+        if f.rule == "RP00" or f.severity != "error":
+            continue  # pragma hygiene / info findings aren't suppressible
         for ln in (f.line, f.line - 1):
-            a = allows.get(ln)
-            if a is not None and f.rule in a[0]:
-                f.suppressed = True
-                f.reason = a[1]
+            for p in allows.get(ln, []):
+                if f.rule in p.rules:
+                    f.suppressed = True
+                    f.reason = p.reason
+                    p.matched = True
+                    break
+            if f.suppressed:
                 break
+    for p in pragmas:
+        if p.matched or not p.rules <= evaluated:
+            continue
+        findings.append(Finding(
+            "RP00", relpath, p.line,
+            f"stale pragma: allow[{','.join(sorted(p.rules))}] "
+            "suppresses no finding at this site — the violation it "
+            "covered is gone; remove the pragma",
+        ))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -794,6 +983,8 @@ def iter_package_files(root: str) -> List[str]:
 
 
 def _read(path: str) -> str:
+    """Tolerant read for OPTIONAL analysis inputs (the doc, the
+    consumer text): missing files stand a rule down, never crash."""
     try:
         with open(path, encoding="utf-8") as f:
             return f.read()
@@ -801,12 +992,48 @@ def _read(path: str) -> str:
         return ""
 
 
+def _read_strict(path: str) -> str:
+    """Strict read for the lint TARGETS themselves: an unreadable file
+    must abort the run (internal error, exit 2), not silently shrink it
+    — a partial run reporting 'clean' is the exit-code bug ISSUE 11
+    closes."""
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def _build_index(
+    sources: Sequence[Tuple[str, str]],
+) -> Tuple[PackageIndex, Dict[str, ast.Module]]:
+    """RP09's one-level call-resolution index over the lint targets
+    (``(relpath, source)`` pairs): parsed trees plus each file's
+    pragma-suppressed lines (a sync the owning file justified does not
+    propagate to its callers).  Also returns the parsed trees so
+    ``lint_package`` parses each target exactly once."""
+    idx = PackageIndex()
+    trees: Dict[str, ast.Module] = {}
+    for rel, src in sources:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue  # the per-file lint reports the syntax error
+        trees[rel] = tree
+        allows, _f, _p = _scan_pragmas(src, rel)
+        sup = {
+            ln: set().union(*(p.rules for p in ps))
+            for ln, ps in allows.items()
+        }
+        idx.add(index_module(rel, tree, sup))
+    return idx, trees
+
+
 def lint_package(root: Optional[str] = None,
                  files: Optional[Sequence[str]] = None) -> dict:
     """Lint the package tree (or an explicit file list) and return the
     stable findings record the CLI serializes with ``--json``:
-    ``{rplint, root, files, findings[], counts, suppressed, ok}`` —
-    rule id / path / line / message / pragma state per finding."""
+    ``{rplint, root, files, findings[], counts, suppressed,
+    unresolvable_emits, ok}`` — rule id / path / line / message /
+    severity / pragma state per finding.  Raises on unreadable lint
+    targets (the CLI maps that to exit code 2)."""
     root = os.path.abspath(root or package_root())
     registry = load_event_registry(
         _read(os.path.join(root, TELEMETRY_MODULE.replace("/", os.sep)))
@@ -825,9 +1052,12 @@ def lint_package(root: Optional[str] = None,
                 rel = os.path.basename(ap)
             paths.append((ap, rel.replace(os.sep, "/")))
         run_drift = False
+    sources = [(rel, _read_strict(abspath)) for abspath, rel in paths]
+    index, trees = _build_index(sources)
     findings: List[Finding] = []
-    for abspath, rel in paths:
-        findings += lint_source(_read(abspath), rel, registry=registry)
+    for rel, src in sources:
+        findings += lint_source(src, rel, registry=registry, index=index,
+                                tree=trees.get(rel))
     doc_path = os.path.join(os.path.dirname(root), ARCHITECTURE_DOC)
     if run_drift and registry is not None and os.path.exists(doc_path):
         # the drift check is a repo-time gate: an installed package
@@ -839,28 +1069,69 @@ def lint_package(root: Optional[str] = None,
             os.path.join(root, TRACE_REPORT_MODULE.replace("/", os.sep))
         )
         findings += check_registry_drift(registry, consumer, _read(doc_path))
-    active = [f for f in findings if not f.suppressed]
+    active = [f for f in findings
+              if not f.suppressed and f.severity == "error"]
     counts: Dict[str, int] = {}
     for f in active:
         counts[f.rule] = counts.get(f.rule, 0) + 1
     return {
-        "rplint": 1,
+        "rplint": 2,
         "root": root,
         "files": len(paths),
         "findings": [f.to_dict() for f in findings],
         "counts": dict(sorted(counts.items())),
-        "suppressed": len(findings) - len(active),
+        "suppressed": len([f for f in findings if f.suppressed]),
+        "unresolvable_emits": len(
+            [f for f in findings if f.severity == "info"]
+        ),
         "ok": not active,
     }
 
 
+def diff_baseline(report: dict, baseline: dict) -> dict:
+    """Diff a fresh lint record against a prior ``--json`` record.
+    Findings match on ``(rule, path, message)`` — NOT line — so code
+    motion above a baselined finding never re-flags it.  Returns
+    ``{matched, new[], stale, ok}``: ``new`` are the findings to fail
+    on, ``stale`` counts baseline entries the tree no longer produces
+    (time to re-tighten the baseline)."""
+
+    def active(fs) -> List[dict]:
+        return [
+            f for f in fs
+            if not f.get("suppressed")
+            and f.get("severity", "error") == "error"
+        ]
+
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for f in active(baseline.get("findings", [])):
+        k = (f["rule"], f["path"], f["message"])
+        budget[k] = budget.get(k, 0) + 1
+    matched = 0
+    new: List[dict] = []
+    for f in active(report["findings"]):
+        k = (f["rule"], f["path"], f["message"])
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            matched += 1
+        else:
+            new.append(f)
+    stale = sum(v for v in budget.values() if v > 0)
+    return {"matched": matched, "new": new, "stale": stale,
+            "ok": not new}
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    """CLI face (``cli lint`` delegates here).  Exit 0 iff no
-    unsuppressed finding."""
+    """CLI face (``cli lint`` delegates here).  Exit codes — the
+    contract ``make lint-ci`` and the driver rely on: **0** no
+    unsuppressed finding (none outside the baseline, when one is
+    given), **1** findings, **2** internal error (analysis crash,
+    unreadable target, malformed baseline) — a partial run never
+    reports success."""
     ap = argparse.ArgumentParser(
         prog="rplint",
         description="AST-based invariant checks for this repo's "
-                    "pipeline contracts (rules RP01-RP06; see "
+                    "pipeline contracts (rules RP01-RP09; see "
                     "randomprojection_tpu/analysis/rplint.py)",
     )
     ap.add_argument("paths", nargs="*",
@@ -868,28 +1139,64 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "package, plus the registry drift check)")
     ap.add_argument("--json", action="store_true",
                     help="emit the stable findings record as one JSON "
-                         "object (includes suppressed findings, marked)")
+                         "object (includes suppressed and informational "
+                         "findings, marked)")
     ap.add_argument("--root", default=None,
                     help="package root to resolve rule scoping against "
                          "(default: the installed package)")
+    ap.add_argument("--baseline", default=None, metavar="JSON",
+                    help="a prior `lint --json` record: fail only on "
+                         "findings NOT in it (matched on rule+path+"
+                         "message, so line drift never re-flags) — lets "
+                         "strict rules land without blocking unrelated "
+                         "work")
     args = ap.parse_args(argv)
-    report = lint_package(args.root, files=args.paths or None)
+    try:
+        report = lint_package(args.root, files=args.paths or None)
+        if args.baseline is not None:
+            with open(args.baseline, encoding="utf-8") as f:
+                base = json.load(f)
+            if not isinstance(base, dict) or not isinstance(
+                base.get("findings"), list
+            ):
+                raise ValueError(
+                    f"{args.baseline} is not a lint --json record "
+                    "(no findings list)"
+                )
+            report["baseline"] = diff_baseline(report, base)
+    except Exception as e:
+        # never exit 0 off a crashed/partial run (ISSUE 11 satellite)
+        print(f"rplint: internal error: {e}", file=sys.stderr)
+        return 2
+    ok = report["baseline"]["ok"] if "baseline" in report else report["ok"]
     if args.json:
         print(json.dumps(report))
+        return 0 if ok else 1
+    if "baseline" in report:
+        shown = [Finding(**f) for f in report["baseline"]["new"]]
     else:
         shown = [
-            Finding(**f) for f in report["findings"] if not f["suppressed"]
+            Finding(**f) for f in report["findings"]
+            if not f["suppressed"] and f["severity"] == "error"
         ]
-        for f in shown:
-            print(f.render())
-        status = "clean" if report["ok"] else (
-            "%d finding(s)" % len(shown)
+    for f in shown:
+        print(f.render())
+    status = "clean" if ok else "%d finding(s)" % len(shown)
+    extras = [
+        f"{report['files']} file(s)",
+        f"{report['suppressed']} suppressed finding(s)",
+    ]
+    if report["unresolvable_emits"]:
+        extras.append(
+            f"{report['unresolvable_emits']} unresolvable emit name(s)"
         )
-        print(
-            f"rplint: {status} — {report['files']} file(s), "
-            f"{report['suppressed']} suppressed finding(s)"
+    if "baseline" in report:
+        b = report["baseline"]
+        extras.append(
+            f"baseline: {b['matched']} matched, {b['stale']} stale"
         )
-    return 0 if report["ok"] else 1
+    print(f"rplint: {status} — " + ", ".join(extras))
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":  # pragma: no cover — python -m convenience

@@ -17,9 +17,12 @@ SURVEY.md §6 config/flag system):
 - ``doctor``        per-batch critical-path report from a telemetry
                     JSONL file (alias: ``report``) — stage waterfall,
                     bubbles, degraded-event audit, tripwire status
-- ``lint``          rplint: AST-based checks of the pipeline's invariants
-                    (span balance, event-registry drift, hot-path host
-                    syncs, thread hygiene, determinism, silent swallows)
+- ``lint``          rplint: AST + flow-sensitive checks of the pipeline's
+                    invariants (span balance, event-registry drift,
+                    hot-path host syncs incl. one call deep, thread
+                    hygiene + shutdown protocol, determinism, silent
+                    swallows, Pallas DMA discipline), with
+                    ``--baseline`` diffing for incremental adoption
 """
 
 from __future__ import annotations
@@ -205,14 +208,22 @@ def build_parser():
 
     q = sub.add_parser(
         "lint",
-        help="rplint: AST-based invariant checks (rules RP01-RP06)",
+        help="rplint: AST + flow-sensitive invariant checks "
+             "(rules RP01-RP09)",
         description="Run the project's static-analysis pass "
                     "(randomprojection_tpu/analysis/rplint.py) over the "
                     "installed package: span balance, telemetry.EVENTS "
-                    "registry drift, host syncs in hot-path loops, "
-                    "thread/queue hygiene, ops/ determinism and "
-                    "silently-swallowed exceptions.  Exits non-zero on "
-                    "any finding not suppressed by an inline "
+                    "registry drift, host syncs in hot-path loops "
+                    "(syntactic AND one call deep), thread/queue "
+                    "hygiene and flow-sensitive shutdown protocol, "
+                    "ops/ determinism, silently-swallowed exceptions, "
+                    "and Pallas DMA copy/wait/budget discipline over a "
+                    "shared CFG.  Exit codes: 0 = no unsuppressed "
+                    "finding (none outside the baseline when one is "
+                    "given), 1 = findings, 2 = internal error "
+                    "(unreadable target, malformed baseline, analysis "
+                    "crash) — a partial run never reports success.  "
+                    "Findings are suppressed per line by an inline "
                     "`# rplint: allow[RPxx] — reason` pragma.  Pure "
                     "stdlib AST analysis: never imports or executes the "
                     "code it checks.",
@@ -223,7 +234,13 @@ def build_parser():
     q.add_argument("--json", action="store_true",
                    help="emit the stable findings record as one JSON "
                         "object: rplint version, per-finding rule id / "
-                        "path / line / message / pragma state, counts")
+                        "path / line / message / severity / pragma "
+                        "state, counts, unresolvable-emit tally")
+    q.add_argument("--baseline", default=None, metavar="JSON",
+                   help="a prior `lint --json` record: fail only on "
+                        "findings NOT in it (matched on rule+path+"
+                        "message, so line drift never re-flags a "
+                        "baselined finding)")
 
     q = sub.add_parser(
         "recover",
@@ -587,13 +604,17 @@ def cmd_doctor(args):
 
 def cmd_lint(args):
     """rplint over the package (or explicit paths); returns the exit
-    code — non-zero on unsuppressed findings, so `make lint` and the
-    tier-1 suite gate on a clean tree."""
+    code — 0 clean, 1 on unsuppressed (non-baselined) findings, 2 on an
+    internal error — so `make lint` / `make lint-ci` and the tier-1
+    suite gate on a clean tree and can never mistake a crashed partial
+    run for success."""
     from randomprojection_tpu.analysis import rplint
 
     argv = list(args.paths)
     if args.json:
         argv.append("--json")
+    if args.baseline is not None:
+        argv += ["--baseline", args.baseline]
     return rplint.main(argv)
 
 

@@ -723,6 +723,7 @@ def _fused_raw(
     use_cache = max_slots > 0 and ni > 1 and not no_cache
     cache_scratch = (
         [
+            # rplint: allow[RP07] — cache charged by construction: max_slots is derived FROM _reserved_bytes' remainder, so these slots can never exceed the post-reserve budget
             pltpu.VMEM(
                 (slots, k, BLOCK_D),
                 jnp.float32 if cache_itemsize == 4 else jnp.bfloat16,

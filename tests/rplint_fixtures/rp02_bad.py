@@ -12,6 +12,6 @@ def emits(x):
     telemetry.emit(EVENTS.GOOD)  # ok
     telemetry.emit(f"fam.{x}")  # ok
     name = "dynamic"
-    telemetry.emit(name)  # ok: not statically resolvable
+    telemetry.emit(name)  # informational: unresolvable-emit (never fatal)
     # rplint: allow[RP02] — fixture: suppression case
     telemetry.emit("rogue.event2", x=1)  # suppressed

@@ -1,0 +1,114 @@
+"""RP07 fixture: seeded DMA-discipline violations (linted under the
+virtual relpath ``ops/pallas_kernels.py`` so the kernel-module scoping
+and the ``_reserved_bytes`` budget cross-check apply).
+
+Expected findings: one unbudgeted VMEM allocation, one never-waited
+copy family, two conditional-wait starts (warm-up + in-loop), one
+slot re-target (phase +2 on 2 revolving slots), one modulus mismatch
+(% 4 vs declared 2-slot scratch) — plus one pragma-suppressed twin of
+the never-waited case."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_VMEM_HEADROOM = 3 << 20
+
+
+def _reserved_bytes(block_n, k):
+    """The module's VMEM budget (the RP07 cross-check target)."""
+    return 2 * block_n * 128 * 4 + 2 * block_n * k * 4 + _VMEM_HEADROOM
+
+
+def _launch(kernel, block_n, k, depth):
+    scratch = [
+        pltpu.VMEM((2, block_n, 128), jnp.float32),  # budgeted, 2 slots
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.VMEM((depth, k, 128), jnp.float32),  # VIOLATION: unbudgeted
+    ]
+    return kernel, scratch
+
+
+def _kernel_unwaited(x_hbm, o_ref, buf, sem, *, n):
+    def tile_copy(t):
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(t, 8)], buf.at[t % 2], sem.at[t % 2]
+        )
+
+    tile_copy(0).start()  # VIOLATION: this family is never waited
+
+    def body(t, _):
+        tile_copy(t + 1).start()
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def _kernel_conditional_wait(x_hbm, o_ref, buf, sem, *, n):
+    def tile_copy(t):
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(t, 8)], buf.at[t % 2], sem.at[t % 2]
+        )
+
+    tile_copy(0).start()  # VIOLATION: the wait below is conditional
+
+    def body(t, _):
+        @pl.when(t + 1 < n)
+        def _():
+            tile_copy(t + 1).start()  # VIOLATION: wait not on all paths
+
+        @pl.when(t > 0)
+        def _():
+            tile_copy(t).wait()  # skipped when t == 0
+
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def _kernel_retarget(x_hbm, o_ref, buf, sem, *, n):
+    def tile_copy(t):
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(t, 8)], buf.at[t % 2], sem.at[t % 2]
+        )
+
+    tile_copy(0).start()
+
+    def body(t, _):
+        tile_copy(t + 2).start()  # VIOLATION: +2 phase on 2 slots
+        tile_copy(t).wait()
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def _kernel_modulus(x_hbm, o_ref, buf, sem, *, n):
+    def tile_copy(t):  # VIOLATION: % 4 but the scratch declares 2 slots
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(t, 8)], buf.at[t % 4], sem.at[t % 4]
+        )
+
+    tile_copy(0).start()
+
+    def body(t, _):
+        tile_copy(t + 1).start()
+        tile_copy(t).wait()
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def _kernel_unwaited_suppressed(x_hbm, o_ref, buf, sem, *, n):
+    def tile_copy(t):
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(t, 8)], buf.at[t % 2], sem.at[t % 2]
+        )
+
+    # rplint: allow[RP07] — fixture: suppression case
+    tile_copy(0).start()  # suppressed
+
+    def body(t, _):
+        tile_copy(t + 1).start()
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)

@@ -1,0 +1,106 @@
+"""RP08 fixture: seeded thread/queue-protocol violations (the rule is
+flow-sensitive and runs on every module, so no virtual relpath is
+needed).
+
+Expected findings: one thread unjoined on the exception path, one
+thread never joined at all (the module HAS a ``.join(`` so per-line
+RP04 stays quiet about it), one conditionally-skipped shutdown
+sentinel, one commit-before-yield — plus one pragma-suppressed twin of
+the exception-path case."""
+import queue
+import threading
+
+
+def unjoined_on_exception_path(items):
+    t = threading.Thread(target=print, daemon=True)
+    t.start()  # VIOLATION: the raise below skips the join
+    for item in items:
+        if item is None:
+            raise ValueError("bad item")
+    t.join()
+    return items
+
+
+def second_thread_never_joined(work):
+    a = threading.Thread(target=print, daemon=True)
+    b = threading.Thread(target=print, daemon=True)
+    a.start()
+    b.start()  # VIOLATION: b is never joined (a's join satisfies RP04)
+    try:
+        work()
+    finally:
+        a.join()
+
+
+def joined_in_finally_ok(work):
+    t = threading.Thread(target=print, daemon=True)
+    t.start()  # ok: every path (return, raise, fall-through) joins
+    try:
+        work()
+        if not work:
+            return None
+    finally:
+        t.join(timeout=5.0)
+    return work
+
+
+def pool_joined_ok(n, work):
+    workers = [
+        threading.Thread(target=print, daemon=True) for _ in range(n)
+    ]
+    for t in workers:
+        t.start()  # ok: the finally joins the whole pool
+    try:
+        work()
+    finally:
+        for t in workers:
+            t.join(timeout=5.0)
+
+
+class BadServer:
+    _SENTINEL = object()
+
+    def __init__(self):
+        self._q = queue.Queue(maxsize=8)
+        self._pending = 0
+
+    def close(self):  # VIOLATION: sentinel enqueue is conditional
+        if self._pending:
+            self._q.put(self._SENTINEL)
+
+
+class GoodServer:
+    _SENTINEL = object()
+
+    def __init__(self):
+        self._q = queue.Queue(maxsize=8)
+        self._closed = threading.Event()
+
+    def close(self):  # ok: only the closed-flag guard may skip the put
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self._q.put(self._SENTINEL)
+
+
+def commit_before_yield(source, cursor):
+    for lo, batch in source:
+        cursor.rows_done = lo + len(batch)  # VIOLATION: commit before ack
+        yield lo, batch
+
+
+def ack_after_yield_ok(source, cursor):
+    for lo, batch in source:
+        yield lo, batch
+        cursor.rows_done = lo + len(batch)  # ok: consumer acked the batch
+
+
+def unjoined_suppressed(items):
+    t = threading.Thread(target=print, daemon=True)
+    # rplint: allow[RP08] — fixture: suppression case
+    t.start()  # suppressed
+    for item in items:
+        if item is None:
+            raise ValueError("bad item")
+    t.join()
+    return items

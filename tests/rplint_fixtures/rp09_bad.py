@@ -1,0 +1,49 @@
+"""RP09 fixture: host syncs hidden one call behind hot loops (linted
+under the virtual relpath ``streaming.py`` so the hot-module scoping
+applies).
+
+Expected findings: one module-function helper call and one
+``self.``-method call, each reaching a host sync from a loop body —
+plus one pragma-suppressed twin.  The direct syncs RP03 owns are
+deliberately absent, and the same helper called OUTSIDE a loop stays
+silent."""
+import numpy as np
+
+
+def _materialize(y):
+    return np.asarray(y)  # the hidden host sync
+
+
+def _shape_of(y):
+    return y.shape  # clean helper: no sync
+
+
+def hot_loop(batches):
+    out = []
+    for y in batches:
+        out.append(_materialize(y))  # VIOLATION: helper-hidden sync
+        _shape_of(y)  # ok: callee performs no sync
+    return out
+
+
+def cold_call(y):
+    return _materialize(y)  # ok: not inside a loop
+
+
+class Tier:
+    def _fetch(self, y):
+        return float(y.sum())  # the hidden host sync
+
+    def drain(self, ys):
+        acc = 0.0
+        for y in ys:
+            acc += self._fetch(y)  # VIOLATION: method-hidden sync
+        return acc
+
+
+def hot_loop_suppressed(batches):
+    out = []
+    for y in batches:
+        # rplint: allow[RP09] — fixture: suppression case
+        out.append(_materialize(y))  # suppressed
+    return out
