@@ -12,14 +12,19 @@
 #                      syncs (syntactic + one call deep), thread hygiene
 #                      + flow-sensitive shutdown protocol, ops/
 #                      determinism, silent swallows, Pallas DMA
-#                      copy/wait/budget discipline — non-zero on any
+#                      copy/wait/budget discipline, cross-thread
+#                      shared-state races (RP10) and lock-order
+#                      deadlock analysis (RP11) — non-zero on any
 #                      unsuppressed finding
 #   make lint-ci       `cli lint --json --baseline .rplint_baseline.json`:
 #                      fails only on findings NOT in the committed
 #                      baseline (rule+path+message matching, so line
 #                      drift never re-flags) — the gate new strict rules
 #                      land behind; exit 2 = internal error, never
-#                      silent success off a partial run
+#                      silent success off a partial run.  To accept
+#                      intended new findings: re-run with
+#                      --update-baseline (rewrites the baseline in
+#                      place, pruning stale entries) and commit it.
 #   make tier1         just the test suite
 #   make kernel-smoke  interpreter-mode fused top-k kernel (ISSUE 7) on
 #                      a toy index, parity-asserted against the scan
@@ -64,8 +69,12 @@ lint-ci:
 	  --baseline .rplint_baseline.json > /dev/null \
 	  || { rc=$$?; \
 	       $(PYTHON) -m randomprojection_tpu lint --baseline .rplint_baseline.json; \
+	       echo "lint-ci: to ACCEPT intended new findings (and prune stale baseline entries), run:"; \
+	       echo "  $(PYTHON) -m randomprojection_tpu lint --baseline .rplint_baseline.json --update-baseline"; \
+	       echo "then commit the rewritten .rplint_baseline.json."; \
 	       exit $$rc; }
 	@echo "lint-ci OK: zero non-baselined findings"
+	@echo "  (baseline workflow: 'lint --baseline .rplint_baseline.json --update-baseline' rewrites the baseline in place; '--sarif PATH' emits SARIF 2.1.0 for CI annotation)"
 
 kernel-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import numpy as np; \

@@ -10,12 +10,14 @@ emitted event names must stay in agreement with ``telemetry.EVENTS`` /
 swallow errors silently.
 
 ``rplint`` is the checker that turns those conventions into rules
-(RP01–RP09, see ``rplint.RULES``).  Since ISSUE 11 it is a small
+(RP01–RP11, see ``rplint.RULES``).  Since ISSUE 11 it is a small
 flow-sensitive framework: ``cfg.py`` builds statement-level CFGs (with
-Pallas ``@pl.when``/``fori_loop`` splicing) and a one-level
-intra-package call index; ``flowrules.py`` implements the
-path-sensitive rules (RP07 DMA discipline, RP08 thread/queue protocol,
-RP09 interprocedural host-sync) on top; ``rplint.py`` keeps the
+Pallas ``@pl.when``/``fori_loop`` splicing), lexical lock regions,
+thread-role discovery and a one-level intra-package call index;
+``flowrules.py`` implements the path-sensitive rules (RP07 DMA
+discipline, RP08 thread/queue protocol, RP09 interprocedural
+host-sync, and — since ISSUE 12 — RP10 cross-thread shared-state races
+and RP11 lock-order deadlock analysis) on top; ``rplint.py`` keeps the
 per-line rules, the pragma grammar, and the CLI.  Each finding is
 suppressible per line with an inline pragma carrying a reason::
 
@@ -24,8 +26,10 @@ suppressible per line with an inline pragma carrying a reason::
 Entry points: ``cli lint`` / ``make lint`` (runs over the shipped
 package and must exit 0 — exit 1 means findings, exit 2 an internal
 error, never silent success off a partial run), ``make lint-ci``
-(``--baseline .rplint_baseline.json``: fail only on NEW findings),
-``make verify`` (both before tier-1), and the library surface below for
+(``--baseline .rplint_baseline.json``: fail only on NEW findings;
+``--update-baseline`` rewrites the baseline in place to accept them),
+``--sarif PATH`` (SARIF 2.1.0 for CI/editor annotation), ``make
+verify`` (before tier-1), and the library surface below for
 programmatic use.  Pure stdlib — importing this package never pulls
 jax/numpy in.
 """
@@ -39,6 +43,7 @@ from randomprojection_tpu.analysis.rplint import (
     lint_source,
     load_event_registry,
     main,
+    to_sarif,
 )
 
 __all__ = [
@@ -50,4 +55,5 @@ __all__ = [
     "lint_source",
     "load_event_registry",
     "main",
+    "to_sarif",
 ]

@@ -58,6 +58,10 @@ __all__ = [
     "ModuleInfo",
     "PackageIndex",
     "index_module",
+    "lock_name",
+    "LockRegions",
+    "lock_regions",
+    "thread_entries",
 ]
 
 # a branch condition: (ast.dump of the test expression, polarity)
@@ -469,6 +473,127 @@ def dominators(cfg: CFG) -> List[Set[int]]:
                 dom[i] = new
                 changed = True
     return dom
+
+
+# -- lock regions (ISSUE 12: RP10/RP11 substrate) ----------------------------
+
+
+def lock_name(expr: ast.AST) -> Optional[str]:
+    """Dotted name of a lock-like ``with`` context manager, else None.
+
+    The heuristic: a *bare* Name/Attribute context manager
+    (``with self._lock:``, ``with _SPAN_LOCK:``) is a synchronization
+    primitive — locks, conditions and semaphores are the only common
+    objects entered without a constructing call, while every other
+    context manager (``open(...)``, ``span(...)``, ``Lock()``) reaches
+    the ``with`` through a Call and is excluded."""
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        d = dotted(expr)
+        return d or None
+    return None
+
+
+@dataclasses.dataclass
+class LockRegions:
+    """Lexical lock-region view of one function:
+
+    - ``held``: ``id(ast node) -> tuple of lock names held`` where that
+      node evaluates (outermost first).  Computed over ``with``-lock
+      bodies; nested function definitions are excluded — their bodies
+      run at their call sites, not inside the enclosing ``with``.
+    - ``acquisitions``: every lock acquisition in the function as
+      ``(lock name, line, locks already held at that point)`` — the
+      raw edges of the lock-order graph.
+    """
+
+    held: Dict[int, Tuple[str, ...]]
+    acquisitions: List[Tuple[str, int, Tuple[str, ...]]]
+
+
+def lock_regions(func: ast.AST) -> LockRegions:
+    """Per-node held-lock map + acquisition list for one function (or
+    module) body.  Lexical: a ``with self._lock:`` region covers its
+    body (and the later items of its own ``with`` statement — item k+1
+    is acquired while item k is held), matching Python's guarantee that
+    the lock is held exactly for the statement's suite."""
+    held: Dict[int, Tuple[str, ...]] = {}
+    acquisitions: List[Tuple[str, int, Tuple[str, ...]]] = []
+
+    def visit(node: ast.AST, stack: Tuple[str, ...]) -> None:
+        held[id(node)] = stack
+        if isinstance(node, _FUNC_NODES + (ast.Lambda,)) and node is not func:
+            return  # nested def: runs at its call site, not here
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = stack
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    held.setdefault(id(sub), inner)
+                if item.optional_vars is not None:
+                    for sub in ast.walk(item.optional_vars):
+                        held.setdefault(id(sub), inner)
+                name = lock_name(item.context_expr)
+                if name is not None:
+                    acquisitions.append(
+                        (name, item.context_expr.lineno, inner)
+                    )
+                    inner = inner + (name,)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    body = getattr(func, "body", [])
+    for stmt in body:
+        visit(stmt, ())
+    return LockRegions(held, acquisitions)
+
+
+# -- thread roles (ISSUE 12: RP10 substrate) ---------------------------------
+
+
+def thread_entries(
+    scope: ast.AST,
+    methods: Dict[str, ast.AST],
+    nested: Dict[str, ast.AST],
+) -> List[Tuple[str, ast.AST, int]]:
+    """Thread entry points constructed anywhere in ``scope``: every
+    ``Thread(target=X)`` whose target resolves statically — ``self.m``
+    against ``methods`` or a bare name against ``nested`` (nested defs /
+    module functions).  Returns ``(role name, entry def, construction
+    line)`` triples; each entry function is the root of one thread
+    *role* (the code that runs on that thread), the constructing code
+    being the implicit "main" role."""
+    out: List[Tuple[str, ast.AST, int]] = []
+    seen: Set[int] = set()
+    for n in ast.walk(scope):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else ""
+        )
+        if name != "Thread":
+            continue
+        target = next(
+            (k.value for k in n.keywords if k.arg == "target"), None
+        )
+        if target is None:
+            continue
+        entry: Optional[ast.AST] = None
+        role = ""
+        if isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ) and target.value.id == "self":
+            entry = methods.get(target.attr)
+            role = f"self.{target.attr}"
+        elif isinstance(target, ast.Name):
+            entry = nested.get(target.id)
+            role = target.id
+        if entry is not None and id(entry) not in seen:
+            seen.add(id(entry))
+            out.append((role, entry, n.lineno))
+    return out
 
 
 # -- one-level intra-package call resolution ---------------------------------

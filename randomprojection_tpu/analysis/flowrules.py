@@ -1,4 +1,4 @@
-"""Flow-sensitive rplint rules RP07-RP09 (ISSUE 11).
+"""Flow-sensitive rplint rules RP07-RP11 (ISSUE 11, grown by ISSUE 12).
 
 Built on the ``cfg`` substrate.  Each rule function returns plain
 ``(line, message)`` pairs; ``rplint.py`` wraps them into findings,
@@ -29,11 +29,31 @@ runs on).
   package index, and a callee containing an unsuppressed
   ``np.asarray`` / ``.block_until_ready`` / ``jax.device_get`` /
   ``float()``-on-expression is reported at the call site.
+- **RP10 shared-state races** (ISSUE 12) — thread *roles* are derived
+  from RP08's thread discovery (each ``Thread(target=...)`` entry point
+  plus the constructing "main" role); per-role ``self.``-attribute
+  read/write sets are computed transitively one call level at a time
+  through the package index (lock context folding through each call
+  site), and any attribute with a cross-role write/write or read/write
+  pair is flagged unless every access path holds the *same* lock
+  (``with self._lock:`` regions on the CFG), the value crosses roles
+  only through the attribute's own method calls (the ``queue.Queue``
+  put/get handoff — the object's methods own their synchronization), or
+  every write dominates every thread ``.start()`` call (init-only
+  state, via the dominator query).  Classes (and module globals) with
+  no thread roles still get the lock-*consistency* leg: state touched
+  under a lock somewhere must hold that lock on every post-init access.
+- **RP11 lock-order deadlock lint** (ISSUE 12) — the lock-acquisition
+  ordering graph (nested ``with``-lock regions, including one call
+  level through the package index) must be acyclic, and no blocking
+  call (``queue.put`` / ``.join`` / ``future.result``) may run while a
+  lock is held.
 """
 
 from __future__ import annotations
 
 import ast
+import dataclasses
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from randomprojection_tpu.analysis.cfg import (
@@ -45,9 +65,11 @@ from randomprojection_tpu.analysis.cfg import (
     dotted as _dotted,
     exit_reachable_without,
     index_module,
+    lock_regions,
     node_reachable_without,
     parents_map as _parents_map,
     shallow_walk,
+    thread_entries,
 )
 
 __all__ = [
@@ -55,6 +77,8 @@ __all__ = [
     "rule_rp07",
     "rule_rp08",
     "rule_rp09",
+    "rule_rp10",
+    "rule_rp11",
 ]
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -518,13 +542,27 @@ def _name_escapes_scope(func: ast.AST, name: str) -> bool:
     return False
 
 
+def _thread_call_lines(node: ast.AST, thread_imported: bool) -> Set[int]:
+    """Linenos of every ``Thread(...)`` construction inside ``node`` —
+    the lines RP04's per-line findings anchor to, so RP08 coverage can
+    be matched back for the one-bug-one-report dedupe."""
+    return {
+        n.lineno
+        for n in ast.walk(node)
+        if isinstance(n, ast.Call) and _is_thread_call(n, thread_imported)
+    }
+
+
 def _rp08_function(func: ast.AST, thread_imported: bool,
-                   out: List[Tuple[int, str]]) -> None:
+                   out: List[Tuple[int, str]],
+                   covered: Set[int]) -> None:
     cfg = build_cfg(func)
 
-    # thread variables and collections (name -> contents for closure)
+    # thread variables and collections (name -> contents for closure);
+    # cons_lines: thread name -> Thread() construction linenos
     threads: Set[str] = set()
     contents: Dict[str, Set[str]] = {}
+    cons_lines: Dict[str, Set[int]] = {}
     for node in cfg.nodes:
         for sub in shallow_walk(node):
             # append-built pools: pool.append(t) makes pool a thread
@@ -549,9 +587,13 @@ def _rp08_function(func: ast.AST, thread_imported: bool,
                 v, thread_imported
             ):
                 threads.add(tgt)
+                cons_lines.setdefault(tgt, set()).add(v.lineno)
             elif isinstance(v, (ast.ListComp, ast.GeneratorExp)) and \
                     _contains_thread_call(v, thread_imported):
                 threads.add(tgt)
+                cons_lines.setdefault(tgt, set()).update(
+                    _thread_call_lines(v, thread_imported)
+                )
             elif isinstance(v, (ast.Tuple, ast.List)):
                 inner: Set[str] = set()
                 for e in v.elts:
@@ -613,6 +655,10 @@ def _rp08_function(func: ast.AST, thread_imported: bool,
     for node_idx, target, line in starts:
         if _name_escapes_scope(func, target):
             continue  # ownership (and join duty) left this function
+        # this thread's join protocol is flow-checked here — RP04's
+        # per-line no-join heuristic would be a duplicate report
+        for name in covers(target):
+            covered.update(cons_lines.get(name, ()))
         join_nodes = {n for n, jt in joins if target in covers(jt)}
         if not join_nodes:
             out.append((
@@ -636,9 +682,11 @@ _CLOSED_GUARD_MARKERS = ("closed", "stop", "shutdown", "done")
 
 
 def _rp08_class(cls: ast.ClassDef, thread_imported: bool,
-                out: List[Tuple[int, str]]) -> None:
+                out: List[Tuple[int, str]],
+                covered: Set[int]) -> None:
     # attribute-held threads: self.X = threading.Thread(...)
     attr_threads: Dict[str, int] = {}
+    attr_cons: Dict[str, int] = {}
     for n in ast.walk(cls):
         if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
                 isinstance(n.targets[0], ast.Attribute) and isinstance(
@@ -647,6 +695,7 @@ def _rp08_class(cls: ast.ClassDef, thread_imported: bool,
                     n.value, ast.Call) and _is_thread_call(
                     n.value, thread_imported):
             attr_threads[n.targets[0].attr] = n.lineno
+            attr_cons[n.targets[0].attr] = n.value.lineno
     methods = {m.name: m for m in cls.body if isinstance(m, _FUNC_NODES)}
     close_like = [methods[m] for m in _CLOSE_METHODS if m in methods]
 
@@ -665,6 +714,7 @@ def _rp08_class(cls: ast.ClassDef, thread_imported: bool,
     for attr, line in attr_threads.items():
         if "start" not in attr_calls(cls, attr):
             continue
+        covered.add(attr_cons[attr])  # flow-checked: dedupe RP04's no-join
         if "join" not in attr_calls(cls, attr):
             out.append((
                 line,
@@ -776,9 +826,16 @@ def _rp08_ack_after_yield(func: ast.AST,
             ))
 
 
-def rule_rp08(tree: ast.Module) -> List[Tuple[int, str]]:
-    """Thread/queue protocol over one module (see module docstring)."""
+def rule_rp08(tree: ast.Module) -> Tuple[List[Tuple[int, str]], Set[int]]:
+    """Thread/queue protocol over one module (see module docstring).
+
+    Returns ``(findings, covered)`` where ``covered`` is the set of
+    ``Thread(...)`` construction linenos whose join protocol this rule
+    actually flow-checked (started, non-escaping threads — flagged OR
+    passed).  RP04's per-line no-join heuristic stands down on those
+    lines so one missing join never reports twice (ISSUE 12)."""
     out: List[Tuple[int, str]] = []
+    covered: Set[int] = set()
     thread_imported = any(
         isinstance(n, ast.ImportFrom) and n.module
         and n.module.endswith("threading")
@@ -786,12 +843,12 @@ def rule_rp08(tree: ast.Module) -> List[Tuple[int, str]]:
         for n in ast.walk(tree)
     )
     for func in _scopes(tree):
-        _rp08_function(func, thread_imported, out)
+        _rp08_function(func, thread_imported, out, covered)
         _rp08_ack_after_yield(func, out)
     for n in ast.walk(tree):
         if isinstance(n, ast.ClassDef):
-            _rp08_class(n, thread_imported, out)
-    return out
+            _rp08_class(n, thread_imported, out, covered)
+    return out, covered
 
 
 # -- RP09: interprocedural host-sync -----------------------------------------
@@ -882,4 +939,676 @@ def rule_rp09(tree: ast.Module, relpath: str,
                 "the loop on d2h every iteration; overlap the fetch or "
                 "hoist the call",
             ))
+    return out
+
+
+# -- RP10: cross-thread shared-state races (ISSUE 12) ------------------------
+
+
+@dataclasses.dataclass
+class _Access:
+    """One data access of a shared name: ``kind`` is ``read``/``write``
+    for the binding itself and ``call`` for a method call *on* the
+    bound object (``self._q.put(...)``) — call accesses are the
+    object's own synchronization concern (the queue.Queue handoff
+    exemption) and never participate in conflicts; ``init`` marks a
+    write proven to happen before any thread publication."""
+
+    name: str
+    kind: str
+    role: str
+    locks: frozenset
+    line: int
+    fn: str
+    relpath: str
+    init: bool = False
+
+
+def _fn_params(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {x.arg for x in a.args + a.posonlyargs + a.kwonlyargs}
+    if a.vararg is not None:
+        names.add(a.vararg.arg)
+    if a.kwarg is not None:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _scan_self(func: ast.AST, parents: Dict[ast.AST, ast.AST],
+               relpath: str, method_names: Set[str]):
+    """``self.``-attribute data accesses of one function (with the
+    locks lexically held at each), plus its resolvable call edges:
+    ``("self", name, locks, line)`` for same-class method calls and
+    ``("name", name, locks, line)`` for bare-name calls.  A direct
+    ``self.x(...)`` call where ``x`` is NOT a class method is a *read*
+    of a stored callable, not a call edge."""
+    regions = lock_regions(func)
+    accs: List[_Access] = []
+    calls: List[Tuple[str, str, frozenset, int]] = []
+    for n in _own_nodes(func):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+            calls.append((
+                "name", n.func.id,
+                frozenset(regions.held.get(id(n), ())), n.lineno,
+            ))
+            continue
+        if not (isinstance(n, ast.Attribute) and isinstance(
+                n.value, ast.Name) and n.value.id == "self"):
+            continue
+        locks = frozenset(regions.held.get(id(n), ()))
+        p = parents.get(n)
+        if isinstance(n.ctx, (ast.Store, ast.Del)):
+            kind = "write"
+        elif isinstance(p, ast.Call) and p.func is n:
+            if n.attr in method_names:
+                calls.append(("self", n.attr, locks, n.lineno))
+                continue
+            kind = "read"  # stored callable: self.prepare(batch)
+        elif isinstance(p, ast.Attribute):
+            gp = parents.get(p)
+            kind = (
+                "call"
+                if isinstance(gp, ast.Call) and gp.func is p
+                else "read"
+            )
+        elif isinstance(p, ast.Subscript) and p.value is n and isinstance(
+            p.ctx, (ast.Store, ast.Del)
+        ):
+            kind = "write"  # container mutation: self._tallies[k] = v
+        else:
+            kind = "read"
+        accs.append(_Access(
+            n.attr, kind, "", locks, n.lineno,
+            getattr(func, "name", "<module>"), relpath,
+        ))
+    return accs, calls
+
+
+def _resolve_bare(name: str, func: ast.AST,
+                  parents: Dict[ast.AST, ast.AST],
+                  mod: ModuleInfo) -> Optional[ast.AST]:
+    """A bare-name callee, preferring lexical proximity: nested defs of
+    ``func``, then of its enclosing functions, then module-level defs."""
+    scope: Optional[ast.AST] = func
+    while scope is not None:
+        if isinstance(scope, _FUNC_NODES):
+            for stmt in ast.walk(scope):
+                if isinstance(stmt, _FUNC_NODES) and stmt is not scope \
+                        and stmt.name == name:
+                    return stmt
+        scope = parents.get(scope)
+    if name in mod.funcs:
+        return mod.funcs[name]
+    return mod.nested.get(name)
+
+
+def _merged_methods(cls: ast.ClassDef, mod: ModuleInfo,
+                    index: PackageIndex
+                    ) -> Tuple[Dict[str, Tuple[ast.AST, str]],
+                               List[Tuple[ast.AST, str]]]:
+    """The class's method table over one level of package-resolvable
+    bases (same-module classes and ``from randomprojection_tpu...
+    import`` names; derived definitions win) — so a subclass's hook
+    methods join the thread roles its base class constructs.  Also
+    returns the *shadowed* base definitions: an overridden base
+    ``__init__`` still runs through ``super().__init__()``, so thread
+    entry points constructed there must stay discoverable."""
+    out: Dict[str, Tuple[ast.AST, str]] = {}
+    shadowed: List[Tuple[ast.AST, str]] = []
+    for base in cls.bases:
+        if not isinstance(base, ast.Name):
+            continue
+        target = mod.imports.get(base.id)
+        if target is not None:
+            other = index.modules.get(target[0])
+            if other is not None:
+                for (cname, mname), fn in other.methods.items():
+                    if cname == target[1]:
+                        out[mname] = (fn, other.relpath)
+        else:
+            for (cname, mname), fn in mod.methods.items():
+                if cname == base.id:
+                    out[mname] = (fn, mod.relpath)
+    for (cname, mname), fn in mod.methods.items():
+        if cname == cls.name:
+            prev = out.get(mname)
+            if prev is not None:
+                shadowed.append(prev)
+            out[mname] = (fn, mod.relpath)
+    return out, shadowed
+
+
+def _publication_nodes(cfg: CFG) -> Set[int]:
+    """CFG nodes of ``__init__`` that may publish ``self`` to a thread:
+    any ``.start()`` call, and ``super().__init__(...)`` (the base
+    constructor may start threads of its own)."""
+    pubs: Set[int] = set()
+    for node in cfg.nodes:
+        for sub in shallow_walk(node):
+            if not (isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute)):
+                continue
+            if sub.func.attr == "start":
+                pubs.add(node.idx)
+            elif sub.func.attr == "__init__" and isinstance(
+                sub.func.value, ast.Call
+            ) and isinstance(sub.func.value.func, ast.Name) and \
+                    sub.func.value.func.id == "super":
+                pubs.add(node.idx)
+    return pubs
+
+
+def _mark_init_writes(init_fn: ast.AST, accs: List[_Access]) -> None:
+    """Mark writes in ``__init__`` that dominate every thread
+    publication point (``.start()`` / ``super().__init__``) on its CFG
+    as init-only: they happen-before the thread exists, so they can
+    never race it."""
+    cfg = build_cfg(init_fn)
+    node_of: Dict[int, int] = {}
+    for node in cfg.nodes:
+        for sub in shallow_walk(node):
+            node_of.setdefault(id(sub), node.idx)
+    pubs = _publication_nodes(cfg)
+    dom = dominators(cfg) if pubs else None
+    by_line: Dict[int, List[int]] = {}
+    for node in cfg.nodes:
+        for sub in shallow_walk(node):
+            if isinstance(sub, ast.Attribute) and isinstance(
+                sub.value, ast.Name
+            ) and sub.value.id == "self":
+                by_line.setdefault(sub.lineno, []).append(node.idx)
+    for a in accs:
+        if a.kind != "write" or a.fn != "__init__":
+            continue
+        nodes = by_line.get(a.line, [])
+        if not nodes:
+            continue
+        if dom is None:
+            a.init = True  # no publication in __init__: trivially before
+        else:
+            a.init = all(
+                any(n in dom[p] for n in nodes) for p in pubs
+            )
+
+
+_HANDOFF_NOTE = (
+    "protect every access path with the same lock, hand the value "
+    "across roles through a queue.Queue, or write it only before the "
+    "thread starts"
+)
+
+
+def _report_conflicts(accs: List[_Access], has_roles: bool, relpath: str,
+                      what: str, out: List[Tuple[int, str]]) -> None:
+    """Conflict detection over one shared name's accesses.  With thread
+    roles: a race is a *cross-role pair* with at least one write and no
+    lock in common — judged pairwise, because same-role accesses run on
+    one thread and can never race each other (an unlocked read on the
+    writer's own thread must not fail a properly locked cross-role
+    pair).  Without roles (no thread constructed here): the
+    lock-*consistency* leg — state touched under a lock somewhere must
+    hold that lock everywhere it is accessed."""
+    post = [a for a in accs if a.kind in ("read", "write") and not a.init]
+    writes = [a for a in post if a.kind == "write"]
+    if not writes:
+        return
+    post.sort(key=lambda a: (a.line, a.kind))
+    if has_roles:
+        pairs = [
+            (a, b)
+            for i, a in enumerate(post) for b in post[i + 1:]
+            if a.role != b.role
+            and ("write" in (a.kind, b.kind))
+            and not (a.locks & b.locks)
+        ]
+        if not pairs:
+            return
+        involved: List[_Access] = []
+        seen: Set[int] = set()
+        for a, b in pairs:
+            for x in (a, b):
+                if id(x) not in seen:
+                    seen.add(id(x))
+                    involved.append(x)
+        involved.sort(key=lambda a: a.line)
+        anchor = next((a for a in involved if a.relpath == relpath), None)
+        if anchor is None:
+            return  # conflict lives entirely in the base module's file
+        mate = None
+        for a, b in pairs:
+            if a is anchor or b is anchor:
+                m = b if a is anchor else a
+                if mate is None or m.line < mate.line:
+                    mate = m
+        w = anchor if anchor.kind == "write" else mate
+        other = mate if w is anchor else anchor
+        out.append((
+            anchor.line,
+            f"{what} is written by role {w.role!r} ({w.fn}, line "
+            f"{w.line}) and {'written' if other.kind == 'write' else 'read'}"
+            f" by role {other.role!r} ({other.fn}, line {other.line}) "
+            f"with no common lock — {_HANDOFF_NOTE}",
+        ))
+    else:
+        common = frozenset.intersection(*(a.locks for a in post))
+        if common:
+            return
+        locked = [a for a in post if a.locks]
+        if not locked:
+            return  # no lock basis to judge a thread-free class against
+        bare = next((a for a in post if not a.locks
+                     and a.relpath == relpath), None)
+        if bare is None:
+            return
+        lock_disp = sorted(locked[0].locks)[0]
+        out.append((
+            bare.line,
+            f"{what} is locked inconsistently: accessed under "
+            f"{lock_disp} ({locked[0].fn}, line {locked[0].line}) but "
+            f"{bare.kind} without it here ({bare.fn}) — every post-init "
+            "access must hold the same lock",
+        ))
+
+
+def _class_rp10(cls: ast.ClassDef, mod: ModuleInfo, index: PackageIndex,
+                parents_of: Dict[str, Dict[ast.AST, ast.AST]],
+                out: List[Tuple[int, str]]) -> None:
+    methods, shadowed = _merged_methods(cls, mod, index)
+    method_names = set(methods)
+
+    def parents_for(rel: str) -> Dict[ast.AST, ast.AST]:
+        if rel not in parents_of:
+            info = index.modules.get(rel)
+            parents_of[rel] = _parents_map(
+                info.tree if info is not None else mod.tree
+            )
+        return parents_of[rel]
+
+    # thread entry points over the merged method bodies — and the
+    # shadowed base bodies (super().__init__() still runs them), with
+    # the target resolved against the MERGED table so a derived
+    # override of the entry point wins
+    rel_of = {id(f): r for _m, (f, r) in methods.items()}
+    entries: List[Tuple[str, ast.AST, str]] = []
+    entry_ids: Set[int] = set()
+    scan = [(fn, rel) for _m, (fn, rel) in sorted(methods.items())]
+    scan += shadowed
+    mdefs = {m: f for m, (f, _r) in methods.items()}
+    for fn, rel in scan:
+        nested = {
+            n.name: n for n in ast.walk(fn)
+            if isinstance(n, _FUNC_NODES) and n is not fn
+        }
+        for role, entry, _line in thread_entries(fn, mdefs, nested):
+            if id(entry) in entry_ids:
+                continue
+            entry_ids.add(id(entry))
+            entries.append((role, entry, rel_of.get(id(entry), rel)))
+
+    def fold(seeds: List[Tuple[ast.AST, str]], role: str
+             ) -> Tuple[List[_Access], Set[int]]:
+        """Transitive access collection, one call level at a time
+        through the resolvable call edges; the locks held at each call
+        site fold into the callee's access contexts."""
+        accs: List[_Access] = []
+        reached: Set[int] = set()
+        visited: Set[Tuple[int, frozenset]] = set()
+        stack = [(fn, rel, frozenset()) for fn, rel in seeds]
+        while stack:
+            fn, rel, ctx = stack.pop()
+            key = (id(fn), ctx)
+            if key in visited:
+                continue
+            visited.add(key)
+            reached.add(id(fn))
+            a, calls = _scan_self(fn, parents_for(rel), rel, method_names)
+            for acc in a:
+                acc = dataclasses.replace(
+                    acc, role=role, locks=acc.locks | ctx
+                )
+                accs.append(acc)
+            for ckind, cname, clocks, _cline in calls:
+                tgt: Optional[Tuple[ast.AST, str]] = None
+                if ckind == "self":
+                    m = methods.get(cname)
+                    if m is not None:
+                        tgt = m
+                else:
+                    t = _resolve_bare(cname, fn, parents_for(rel),
+                                      index.modules.get(rel, mod))
+                    if t is not None:
+                        tgt = (t, rel)
+                if tgt is not None:
+                    stack.append((tgt[0], tgt[1], ctx | clocks))
+        return accs, reached
+
+    role_accs: List[_Access] = []
+    thread_reached: Set[int] = set()
+    for role, entry, rel in entries:
+        accs, reached = fold([(entry, rel)], role)
+        role_accs += accs
+        thread_reached |= reached
+
+    has_roles = bool(entries)
+    if has_roles:
+        main_seeds = [
+            (fn, rel) for _m, (fn, rel) in sorted(methods.items())
+            if id(fn) not in thread_reached
+        ]
+        accs, _ = fold(main_seeds, "main")
+        role_accs += accs
+    else:
+        # lock-consistency leg: per-method accesses, no role folding
+        for _m, (fn, rel) in sorted(methods.items()):
+            a, _calls = _scan_self(fn, parents_for(rel), rel, method_names)
+            role_accs += [dataclasses.replace(x, role="main") for x in a]
+
+    init = methods.get("__init__")
+    if init is not None:
+        _mark_init_writes(init[0], role_accs)
+
+    by_attr: Dict[str, List[_Access]] = {}
+    for a in role_accs:
+        by_attr.setdefault(a.name, []).append(a)
+    for attr in sorted(by_attr):
+        _report_conflicts(
+            by_attr[attr], has_roles, mod.relpath,
+            f"shared attribute self.{attr} of {cls.name}", out,
+        )
+
+
+def _module_rp10(tree: ast.Module, relpath: str,
+                 out: List[Tuple[int, str]]) -> None:
+    """Module-global leg: names rebound through ``global`` declarations
+    get the lock-consistency check across every function that touches
+    them (the ``_RUN_TOKEN``/``_SPAN_SEQ`` class of state)."""
+    gnames: Set[str] = set()
+    funcs = [n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)]
+    for fn in funcs:
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Global):
+                gnames.update(stmt.names)
+    for g in sorted(gnames):
+        accs: List[_Access] = []
+        for fn in funcs:
+            if g in _fn_params(fn):
+                continue
+            declared = any(
+                isinstance(s, ast.Global) and g in s.names
+                for s in ast.walk(fn)
+            )
+            stores = any(
+                isinstance(n, ast.Name) and n.id == g
+                and isinstance(n.ctx, (ast.Store, ast.Del))
+                for n in _own_nodes(fn)
+            )
+            if stores and not declared:
+                continue  # local shadow, not the module global
+            regions = lock_regions(fn)
+            for n in _own_nodes(fn):
+                if isinstance(n, ast.Name) and n.id == g:
+                    kind = (
+                        "write"
+                        if isinstance(n.ctx, (ast.Store, ast.Del))
+                        else "read"
+                    )
+                    accs.append(_Access(
+                        g, kind, "main",
+                        frozenset(regions.held.get(id(n), ())),
+                        n.lineno, fn.name, relpath,
+                    ))
+        _report_conflicts(accs, False, relpath, f"module global {g}", out)
+
+
+def rule_rp10(tree: ast.Module, relpath: str,
+              index: Optional[PackageIndex] = None
+              ) -> List[Tuple[int, str]]:
+    """Cross-thread shared-state races over one module (see the module
+    docstring).  ``index`` (built by ``lint_package``) lets a subclass
+    in one file join the thread roles its base class constructs in
+    another; without it, roles resolve within the file only."""
+    idx = index if index is not None else PackageIndex()
+    if relpath not in idx.modules:
+        idx = PackageIndex(dict(idx.modules))
+        idx.add(index_module(relpath, tree))
+    mod = idx.modules[relpath]
+    parents_of: Dict[str, Dict[ast.AST, ast.AST]] = {}
+    out: List[Tuple[int, str]] = []
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            _class_rp10(stmt, mod, idx, parents_of, out)
+    _module_rp10(tree, relpath, out)
+    out.sort()
+    return out
+
+
+# -- RP11: lock-order deadlock lint (ISSUE 12) -------------------------------
+
+_BLOCKING_CALLS = {
+    "put": "a full queue blocks the producer inside the critical "
+           "section",
+    "join": "the joined thread may need this very lock to finish",
+    "result": "the future's worker may need this very lock to complete",
+}
+
+
+def _blocking_what(call: ast.Call) -> Optional[str]:
+    """The blocking-call class this call belongs to, with the string /
+    path ``join`` idioms excluded.  A thread join's only positional
+    argument is a numeric timeout — any other positional shape
+    (``sep.join(parts)``, ``"".join(x for ...)``) is a string join."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _BLOCKING_CALLS:
+        return None
+    if f.attr == "join":
+        if isinstance(f.value, ast.Constant):
+            return None  # "sep".join(...)
+        base = _dotted(f.value)
+        if "path" in base.split("."):
+            return None  # os.path.join and friends
+        if call.args and not (
+            len(call.args) == 1
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, (int, float))
+        ):
+            return None  # iterable positional: a string join
+    return f.attr
+
+
+def _sccs(edges: Set[Tuple[str, str]]) -> List[Set[str]]:
+    """Strongly connected components (iterative Tarjan) of the lock
+    graph; only components that can deadlock (size > 1, or a self
+    edge) are returned."""
+    adj: Dict[str, List[str]] = {}
+    nodes: Set[str] = set()
+    for a, b in sorted(edges):
+        adj.setdefault(a, []).append(b)
+        nodes.add(a)
+        nodes.add(b)
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(adj.get(root, ())))]
+        idx[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in idx:
+                    idx[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], idx[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == idx[v]:
+                comp: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.add(w)
+                    if w == v:
+                        break
+                if len(comp) > 1 or (v, v) in edges:
+                    out.append(comp)
+
+    for n in sorted(nodes):
+        if n not in idx:
+            strongconnect(n)
+    return out
+
+
+def rule_rp11(tree: ast.Module, relpath: str,
+              index: Optional[PackageIndex] = None
+              ) -> List[Tuple[int, str]]:
+    """Lock-order deadlock lint: build the lock-acquisition ordering
+    graph (nested ``with``-lock regions, plus acquisitions one call
+    level away through the package index), flag cycles, and flag
+    blocking calls (``.put``/``.join``/``.result``) made while any lock
+    is held."""
+    idx = index if index is not None else PackageIndex()
+    self_info = idx.modules.get(relpath)
+    if self_info is None:
+        self_info = index_module(relpath, tree)
+    parents = _parents_map(tree)
+
+    def encl_class(node: ast.AST) -> Optional[str]:
+        p = parents.get(node)
+        while p is not None and not isinstance(p, ast.ClassDef):
+            p = parents.get(p)
+        return p.name if isinstance(p, ast.ClassDef) else None
+
+    method_class = {
+        id(fn): cname for (cname, _m), fn in self_info.methods.items()
+    }
+
+    def qual(name: str, cls: Optional[str]) -> str:
+        # self.X locks are per-instance: scope them by class so two
+        # classes' self._lock never alias in the order graph
+        if name.startswith("self.") and cls is not None:
+            return f"{cls}.{name[len('self.'):]}"
+        return name
+
+    # locks constructed as threading.RLock(): re-entering one is legal,
+    # so self-edges on them are not findings (order cycles still are)
+    reentrant: Set[str] = set()
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.value, ast.Call)):
+            continue
+        f = n.value.func
+        cname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else ""
+        )
+        if cname != "RLock":
+            continue
+        tgt = n.targets[0]
+        if isinstance(tgt, ast.Attribute) and isinstance(
+            tgt.value, ast.Name
+        ) and tgt.value.id == "self":
+            reentrant.add(qual(f"self.{tgt.attr}", encl_class(n)))
+        elif isinstance(tgt, ast.Name):
+            reentrant.add(tgt.id)
+
+    edges: Dict[Tuple[str, str], int] = {}  # (src, dst) -> earliest line
+    blocking: List[Tuple[int, str, str, str]] = []
+
+    def note_edge(a: str, b: str, line: int) -> None:
+        prev = edges.get((a, b))
+        if prev is None or line < prev:
+            edges[(a, b)] = line
+
+    for fn in _scopes(tree):
+        cls = encl_class(fn)
+        regions = lock_regions(fn)
+        for name, line, held in regions.acquisitions:
+            lid = qual(name, cls)
+            for h in held:
+                note_edge(qual(h, cls), lid, line)
+        for n in _own_nodes(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            held = regions.held.get(id(n), ())
+            if not held:
+                continue
+            what = _blocking_what(n)
+            if what is not None:
+                blocking.append((
+                    n.lineno, what, qual(held[-1], cls), "",
+                ))
+                continue
+            resolved = idx.resolve(n, self_info, cls)
+            if resolved is None:
+                continue
+            owner, callee, display = resolved
+            callee_cls = method_class.get(id(callee)) if (
+                owner.relpath == relpath
+            ) else None
+            sub_regions = lock_regions(callee)
+            for name2, _line2, _held2 in sub_regions.acquisitions:
+                lid2 = qual(name2, callee_cls)
+                for h in held:
+                    note_edge(qual(h, cls), lid2, n.lineno)
+            for sub in _own_nodes(callee):
+                if isinstance(sub, ast.Call):
+                    w = _blocking_what(sub)
+                    if w is not None:
+                        blocking.append((
+                            n.lineno, w, qual(held[-1], cls),
+                            display,
+                        ))
+                        break
+
+    out: List[Tuple[int, str]] = []
+    edge_set = set(edges)
+    for comp in _sccs(edge_set):
+        comp_edges = [
+            (line, a, b) for (a, b), line in edges.items()
+            if a in comp and b in comp
+        ]
+        line = min(l for l, _a, _b in comp_edges)
+        if len(comp) == 1:
+            lock = next(iter(comp))
+            if lock in reentrant:
+                continue  # threading.RLock: re-entry is legal
+            out.append((
+                line,
+                f"lock {lock} is re-acquired while already held "
+                "— threading.Lock is not reentrant; this deadlocks "
+                "immediately",
+            ))
+            continue
+        names = sorted(comp)
+        out.append((
+            line,
+            "lock-order cycle: " + " -> ".join(names + [names[0]]) +
+            " — these locks are acquired in conflicting orders on "
+            "different paths; two threads interleaving them deadlock",
+        ))
+    for line, what, lock, via in blocking:
+        reach = f"call to {via}() reaches " if via else ""
+        out.append((
+            line,
+            f"{reach}blocking .{what}() while holding lock {lock} — "
+            f"{_BLOCKING_CALLS[what]}; move the blocking call outside "
+            "the lock region",
+        ))
+    out.sort()
     return out

@@ -66,6 +66,24 @@ shape.
   (same-module defs, ``self.`` methods, ``from randomprojection_tpu...
   import`` names) whose callee performs an unsuppressed host sync is
   reported at the call site — the helper-hidden stall r9 fixed by hand.
+- **RP10 shared-state races** (concurrency modules; ISSUE 12) — thread
+  roles derive from RP08's discovery (one role per ``Thread(target=…)``
+  entry point plus the constructing "main" role, subclass hooks joining
+  their base class's roles through the package index); per-role
+  ``self.``-attribute read/write sets fold transitively one call level
+  at a time with the lock context of each call site, and a cross-role
+  write/write or read/write pair is a finding unless every access path
+  holds the same lock, the value crosses roles only through the
+  object's own method calls (the ``queue.Queue`` handoff), or every
+  write dominates every ``.start()`` (init-only, by dominator query).
+  Lock-holding classes and module globals *without* thread roles get
+  the lock-consistency leg instead: state touched under a lock must
+  hold it on every post-init access.
+- **RP11 lock-order deadlocks** (concurrency modules; ISSUE 12) — the
+  lock-acquisition ordering graph (nested ``with``-lock regions, one
+  call level deep) must be acyclic, and no blocking call
+  (``queue.put``, ``.join``, ``future.result``) may run while a lock is
+  held.
 
 Suppression pragma (same line as the finding, the line directly above
 it, or any physical line of the same logical statement — so pragmas on
@@ -126,6 +144,7 @@ __all__ = [
     "lint_source",
     "lint_package",
     "package_root",
+    "to_sarif",
     "main",
 ]
 
@@ -159,6 +178,12 @@ RULES = {
             "no cursor commit dominates its batch's yield",
     "RP09": "interprocedural host-sync: hot-module loops must not call a "
             "package helper (one level deep) that performs a host sync",
+    "RP10": "shared-state races: state shared across thread roles needs "
+            "a common lock on every access path, a queue handoff, or "
+            "init-only writes that dominate the thread start",
+    "RP11": "lock-order deadlocks: the lock-acquisition ordering graph "
+            "must be acyclic, and no blocking call (queue.put / .join / "
+            "future.result) may run while a lock is held",
 }
 
 # -- rule scoping (paths are package-relative, '/'-separated) ----------------
@@ -197,6 +222,18 @@ KERNEL_BUDGET_FNS = {
     "ops/topk_kernels.py": "plan_fused",
 }
 KERNEL_MODULES = tuple(KERNEL_BUDGET_FNS)
+# RP10/RP11 (ISSUE 12): the modules where threads and locks meet — the
+# four thread/queue substrates (PrefetchSource + StagedIngestSource,
+# TopKServer, ShardedTopKServer) plus the lock-holding telemetry,
+# sharded-index and hashing modules
+CONCURRENCY_MODULES = (
+    "streaming.py",
+    "models/sketch.py",
+    "serving/server.py",
+    "serving/sharded_index.py",
+    "utils/telemetry.py",
+    "ops/hashing.py",
+)
 # RP05: Generator-construction surface of np.random that stays legal
 RNG_FACTORY_OK = frozenset(
     {"default_rng", "Generator", "SeedSequence", "PCG64", "Philox",
@@ -733,8 +770,10 @@ def _rule_rp03(tree: ast.Module, relpath: str) -> List[Finding]:
     return out
 
 
-def _rule_rp04(tree: ast.Module, relpath: str) -> List[Finding]:
+def _rule_rp04(tree: ast.Module, relpath: str,
+               rp08_covered: Optional[Set[int]] = None) -> List[Finding]:
     out: List[Finding] = []
+    rp08_covered = rp08_covered or set()
     thread_imported = _imports_name(tree, "threading", "Thread")
     queue_imported = any(
         _imports_name(tree, "queue", n) for n in ("Queue", "LifoQueue")
@@ -799,6 +838,10 @@ def _rule_rp04(tree: ast.Module, relpath: str) -> List[Finding]:
                 ))
     if threads and not has_join:
         for n in threads:
+            if n.lineno in rp08_covered:
+                # RP08's flow-sensitive join check already covers this
+                # thread (flagged or passed) — one bug, one report
+                continue
             out.append(Finding(
                 "RP04", relpath, n.lineno,
                 "threading.Thread constructed but no .join( appears in "
@@ -908,7 +951,13 @@ def lint_source(src: str, relpath: str, *,
     if relpath in HOT_MODULES:
         evaluated.add("RP03")
         findings += _rule_rp03(tree, relpath)
-    findings += _rule_rp04(tree, relpath)
+    # RP08 runs before RP04 so its flow-checked threads can stand the
+    # per-line no-join heuristic down (one bug, one report — ISSUE 12)
+    rp08_out, rp08_covered = flowrules.rule_rp08(tree)
+    findings += [
+        Finding("RP08", relpath, ln, msg) for ln, msg in rp08_out
+    ]
+    findings += _rule_rp04(tree, relpath, rp08_covered)
     if relpath.startswith(DETERMINISM_PREFIXES):
         evaluated.add("RP05")
         findings += _rule_rp05(tree, relpath)
@@ -923,10 +972,6 @@ def lint_source(src: str, relpath: str, *,
                 tree, KERNEL_BUDGET_FNS[relpath]
             )
         ]
-    findings += [
-        Finding("RP08", relpath, ln, msg)
-        for ln, msg in flowrules.rule_rp08(tree)
-    ]
     if relpath in HOT_MODULES:
         evaluated.add("RP09")
         sup = {
@@ -938,6 +983,16 @@ def lint_source(src: str, relpath: str, *,
             for ln, msg in flowrules.rule_rp09(
                 tree, relpath, index=index, suppressed=sup
             )
+        ]
+    if relpath in CONCURRENCY_MODULES:
+        evaluated.update(("RP10", "RP11"))
+        findings += [
+            Finding("RP10", relpath, ln, msg)
+            for ln, msg in flowrules.rule_rp10(tree, relpath, index=index)
+        ]
+        findings += [
+            Finding("RP11", relpath, ln, msg)
+            for ln, msg in flowrules.rule_rp11(tree, relpath, index=index)
         ]
     for f in findings:
         if f.rule == "RP00" or f.severity != "error":
@@ -1075,7 +1130,7 @@ def lint_package(root: Optional[str] = None,
     for f in active:
         counts[f.rule] = counts.get(f.rule, 0) + 1
     return {
-        "rplint": 2,
+        "rplint": 3,
         "root": root,
         "files": len(paths),
         "findings": [f.to_dict() for f in findings],
@@ -1085,6 +1140,52 @@ def lint_package(root: Optional[str] = None,
             [f for f in findings if f.severity == "info"]
         ),
         "ok": not active,
+    }
+
+
+_SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def to_sarif(report: dict) -> dict:
+    """Render a ``lint_package`` record as a SARIF 2.1.0 log, so CI
+    runners and editors can annotate findings inline.  Mapping:
+    ``severity`` ``error`` → level ``error``, ``info`` → ``note``;
+    pragma-suppressed findings carry an ``inSource`` suppression with
+    the pragma's reason as justification (SARIF viewers hide them by
+    default but keep the audit trail)."""
+    results = []
+    for f in report["findings"]:
+        res = {
+            "ruleId": f["rule"],
+            "level": "note" if f["severity"] == "info" else "error",
+            "message": {"text": f["message"]},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f["path"]},
+                    "region": {"startLine": max(1, int(f["line"]))},
+                },
+            }],
+        }
+        if f["suppressed"]:
+            res["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f["reason"],
+            }]
+        results.append(res)
+    return {
+        "version": "2.1.0",
+        "$schema": _SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "rplint",
+                "version": str(report["rplint"]),
+                "rules": [
+                    {"id": rid, "shortDescription": {"text": RULES[rid]}}
+                    for rid in sorted(RULES)
+                ],
+            }},
+            "results": results,
+        }],
     }
 
 
@@ -1150,12 +1251,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "message, so line drift never re-flags) — lets "
                          "strict rules land without blocking unrelated "
                          "work")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the --baseline file in place with the "
+                         "fresh lint record: stale entries are pruned, "
+                         "current findings become the accepted baseline "
+                         "(exit 0) — the workflow for accepting intended "
+                         "new findings instead of hand-editing JSON")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write the findings as a SARIF 2.1.0 log "
+                         "to PATH, so CI and editors can annotate them "
+                         "inline")
     args = ap.parse_args(argv)
+    updated: Optional[dict] = None
     try:
+        if args.update_baseline and args.baseline is None:
+            raise ValueError("--update-baseline requires --baseline PATH")
         report = lint_package(args.root, files=args.paths or None)
         if args.baseline is not None:
-            with open(args.baseline, encoding="utf-8") as f:
-                base = json.load(f)
+            if args.update_baseline and not os.path.exists(args.baseline):
+                base: dict = {"findings": []}  # first write starts empty
+            else:
+                with open(args.baseline, encoding="utf-8") as f:
+                    base = json.load(f)
             if not isinstance(base, dict) or not isinstance(
                 base.get("findings"), list
             ):
@@ -1164,11 +1281,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "(no findings list)"
                 )
             report["baseline"] = diff_baseline(report, base)
+            if args.update_baseline:
+                fresh = {k: v for k, v in report.items() if k != "baseline"}
+                tmp = args.baseline + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(fresh, f)
+                    f.write("\n")
+                os.replace(tmp, args.baseline)
+                updated = {
+                    "path": args.baseline,
+                    "accepted_new": len(report["baseline"]["new"]),
+                    "pruned_stale": report["baseline"]["stale"],
+                }
+                report["baseline_updated"] = updated
+        if args.sarif is not None:
+            with open(args.sarif, "w", encoding="utf-8") as f:
+                json.dump(to_sarif(report), f)
+                f.write("\n")
     except Exception as e:
         # never exit 0 off a crashed/partial run (ISSUE 11 satellite)
         print(f"rplint: internal error: {e}", file=sys.stderr)
         return 2
     ok = report["baseline"]["ok"] if "baseline" in report else report["ok"]
+    if updated is not None:
+        ok = True  # the update IS the acceptance of the new findings
     if args.json:
         print(json.dumps(report))
         return 0 if ok else 1
@@ -1194,6 +1330,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         b = report["baseline"]
         extras.append(
             f"baseline: {b['matched']} matched, {b['stale']} stale"
+        )
+    if updated is not None:
+        status = "baseline updated"
+        extras.append(
+            f"{updated['path']} rewritten ({updated['accepted_new']} new "
+            f"finding(s) accepted, {updated['pruned_stale']} stale "
+            "entr(ies) pruned)"
         )
     print(f"rplint: {status} — " + ", ".join(extras))
     return 0 if ok else 1

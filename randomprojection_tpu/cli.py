@@ -21,8 +21,11 @@ SURVEY.md §6 config/flag system):
                     invariants (span balance, event-registry drift,
                     hot-path host syncs incl. one call deep, thread
                     hygiene + shutdown protocol, determinism, silent
-                    swallows, Pallas DMA discipline), with
-                    ``--baseline`` diffing for incremental adoption
+                    swallows, Pallas DMA discipline, cross-thread
+                    shared-state races, lock-order deadlocks), with
+                    ``--baseline`` diffing / ``--update-baseline``
+                    rewriting for incremental adoption and ``--sarif``
+                    output for CI annotation
 """
 
 from __future__ import annotations
@@ -209,7 +212,7 @@ def build_parser():
     q = sub.add_parser(
         "lint",
         help="rplint: AST + flow-sensitive invariant checks "
-             "(rules RP01-RP09)",
+             "(rules RP01-RP11)",
         description="Run the project's static-analysis pass "
                     "(randomprojection_tpu/analysis/rplint.py) over the "
                     "installed package: span balance, telemetry.EVENTS "
@@ -217,8 +220,10 @@ def build_parser():
                     "(syntactic AND one call deep), thread/queue "
                     "hygiene and flow-sensitive shutdown protocol, "
                     "ops/ determinism, silently-swallowed exceptions, "
-                    "and Pallas DMA copy/wait/budget discipline over a "
-                    "shared CFG.  Exit codes: 0 = no unsuppressed "
+                    "Pallas DMA copy/wait/budget discipline, "
+                    "cross-thread shared-state races (thread roles + "
+                    "lock regions on a shared CFG), and lock-order "
+                    "deadlock analysis.  Exit codes: 0 = no unsuppressed "
                     "finding (none outside the baseline when one is "
                     "given), 1 = findings, 2 = internal error "
                     "(unreadable target, malformed baseline, analysis "
@@ -241,6 +246,16 @@ def build_parser():
                         "findings NOT in it (matched on rule+path+"
                         "message, so line drift never re-flags a "
                         "baselined finding)")
+    q.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the --baseline file in place from the "
+                        "fresh lint record (prunes stale entries, "
+                        "accepts current findings; exit 0) — the "
+                        "workflow for adopting intended new findings "
+                        "instead of hand-editing the JSON")
+    q.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write the findings as a SARIF 2.1.0 log "
+                        "to PATH so CI and editors can annotate them "
+                        "inline")
 
     q = sub.add_parser(
         "recover",
@@ -615,6 +630,10 @@ def cmd_lint(args):
         argv.append("--json")
     if args.baseline is not None:
         argv += ["--baseline", args.baseline]
+    if args.update_baseline:
+        argv.append("--update-baseline")
+    if args.sarif is not None:
+        argv += ["--sarif", args.sarif]
     return rplint.main(argv)
 
 

@@ -1313,6 +1313,7 @@ class TopKServer:
             if self._closed.is_set():
                 return
             self._closed.set()
+            # rplint: allow[RP11] — never blocks by construction: the queue is sized max_pending + 1 and submit() bounds occupancy to max_pending under this same lock, so the sentinel's extra slot is always free
             self._q.put(self._SENTINEL)
         if self._thread is not None:
             self._thread.join()
@@ -1368,6 +1369,7 @@ class TopKServer:
     def stats(self) -> dict:
         """Coalescing tallies: served batches/requests/queries and the
         mean rows per coalesced dispatch."""
+        # rplint: allow[RP10] — dispatcher-private monotone int tallies: rebinds are GIL-atomic and stats() is a best-effort snapshot (cross-field staleness acceptable by contract, see the __init__ comment)
         b, r, q = self._batches, self._requests, self._queries
         return {
             "batches": b,

@@ -26,6 +26,8 @@ doctor's serving section reads, alongside the base server's
 
 from __future__ import annotations
 
+import threading
+
 from randomprojection_tpu.models.sketch import TopKServer
 from randomprojection_tpu.utils import telemetry
 from randomprojection_tpu.utils.telemetry import EVENTS
@@ -65,7 +67,11 @@ class ShardedTopKServer(TopKServer):
                 )
         self.replicas = replicas
         self._rr = 0  # dispatcher-thread-private round-robin cursor
+        # the per-replica tallies cross threads (dispatcher writes,
+        # stats() reads) — the one piece of routing state that needs a
+        # lock (RP10); _rr/_picked stay dispatcher-private, lock-free
         self._replica_batches = [0] * len(replicas)
+        self._route_lock = threading.Lock()
         super().__init__(
             first, m, max_batch=max_batch, max_delay_s=max_delay_s,
             max_pending=max_pending, start=start,
@@ -84,7 +90,8 @@ class ShardedTopKServer(TopKServer):
     def _batch_served(self, index, rows: int, padded: int,
                       requests: int, wall: float) -> None:
         r = self._picked
-        self._replica_batches[r] += 1
+        with self._route_lock:
+            self._replica_batches[r] += 1
         reg = telemetry.registry()
         reg.counter_inc("serve.shard.batches")
         reg.counter_inc("serve.shard.requests", requests)
@@ -104,5 +111,6 @@ class ShardedTopKServer(TopKServer):
         """Base coalescing tallies plus the replica routing spread."""
         s = super().stats()
         s["replicas"] = len(self.replicas)
-        s["replica_batches"] = list(self._replica_batches)
+        with self._route_lock:
+            s["replica_batches"] = list(self._replica_batches)
         return s

@@ -465,7 +465,11 @@ def configure(path: str) -> TelemetryLog:
     global _ACTIVE_LOG, _RUN_TOKEN
     if _ACTIVE_LOG is not None:
         _ACTIVE_LOG.close()
-    _RUN_TOKEN = os.urandom(4).hex()
+    with _SPAN_LOCK:
+        # the token pairs with the span sequence under the same lock: a
+        # span id drawn concurrently with configure() must carry either
+        # the old token or the new one, never a torn read (RP10)
+        _RUN_TOKEN = os.urandom(4).hex()
     _ACTIVE_LOG = TelemetryLog(path)
     return _ACTIVE_LOG
 

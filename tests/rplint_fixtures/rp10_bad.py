@@ -1,0 +1,142 @@
+"""RP10 fixture: seeded cross-thread shared-state races (linted under
+a concurrency-module relpath, e.g. ``streaming.py``).
+
+Expected findings: an unlocked cross-role read/write pair, a
+one-side-only locked pair, a write published *after* ``start()``, and
+a lock-consistency violation in a thread-free class — plus one
+pragma-suppressed twin.  The ok-twins (same lock on every access path,
+queue.Queue handoff, init-only writes that dominate the start) produce
+nothing."""
+import queue
+import threading
+
+
+class UnlockedTallies:
+    def __init__(self):
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        for _ in range(10):
+            self._count += 1  # VIOLATION: main reads this with no lock
+
+    def snapshot(self):
+        return self._count
+
+    def close(self):
+        self._thread.join(timeout=5.0)
+
+
+class OneSideLocked:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self._total += 1  # VIOLATION: read side skips the lock
+
+    def read_side(self):
+        return self._total
+
+    def close(self):
+        self._thread.join(timeout=5.0)
+
+
+class LockedOk:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self._n += 1  # ok: every access path holds the same lock
+
+    def read_side(self):
+        with self._lock:
+            return self._n
+
+    def close(self):
+        self._thread.join(timeout=5.0)
+
+
+class QueueHandoffOk:
+    def __init__(self):
+        self._results = queue.Queue(maxsize=8)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self._results.put(1)  # ok: the value crosses roles via the queue
+
+    def drain(self):
+        return self._results.get()
+
+    def close(self):
+        self._thread.join(timeout=5.0)
+
+
+class InitOnlyOk:
+    def __init__(self, cfg):
+        self._cfg = dict(cfg)  # ok: the write dominates the start()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        return len(self._cfg)
+
+    def peek(self):
+        return self._cfg
+
+    def close(self):
+        self._thread.join(timeout=5.0)
+
+
+class WriteAfterStart:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._late = 1  # VIOLATION: published after start(), not init-only
+
+    def _run(self):
+        return self._late
+
+    def close(self):
+        self._thread.join(timeout=5.0)
+
+
+class InconsistentNoThreads:
+    """No thread constructed here — the lock-consistency leg."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def reset(self):
+        self._n = 0  # VIOLATION: locked in bump(), bare write here
+
+
+class SuppressedTallies:
+    def __init__(self):
+        self._hits = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        # rplint: allow[RP10] — fixture: suppression case
+        self._hits += 1
+
+    def peek(self):
+        return self._hits
+
+    def close(self):
+        self._thread.join(timeout=5.0)

@@ -1,0 +1,96 @@
+"""RP11 fixture: seeded lock-order and blocking-under-lock violations
+(linted under a concurrency-module relpath, e.g. ``streaming.py``).
+
+Expected findings: one direct lock-order cycle, one cycle closed
+through a call one level deep, and three blocking calls under a lock
+(queue.put / thread.join / future.result) — plus one pragma-suppressed
+blocking put.  The ok-twins (acyclic nesting, put_nowait, string and
+path joins) produce nothing."""
+import os
+import queue
+import threading
+
+
+class OrderCycle:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:  # acquires a -> b ...
+                return 1
+
+    def ba(self):
+        with self._b:
+            with self._a:  # VIOLATION: ... and b -> a elsewhere
+                return 2
+
+
+class OrderOk:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:  # ok: every path agrees on a -> b
+                return 1
+
+    def two(self):
+        with self._a, self._b:
+            return 2
+
+
+class CallLevelCycle:
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def _take_y(self):
+        with self._y:
+            return 1
+
+    def xy(self):
+        with self._x:
+            return self._take_y()  # x -> y through the call ...
+
+    def yx(self):
+        with self._y:
+            with self._x:  # VIOLATION: ... and y -> x directly
+                return 2
+
+
+class BlockingUnderLock:
+    _SENTINEL = "stop"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue(maxsize=2)
+        self._t = threading.Thread(target=print, daemon=True)
+
+    def enqueue(self):
+        with self._lock:
+            self._q.put(self._SENTINEL)  # VIOLATION: blocking put
+
+    def halt(self):
+        with self._lock:
+            self._t.join(timeout=5.0)  # VIOLATION: join under lock
+
+    def wait(self, fut):
+        with self._lock:
+            return fut.result()  # VIOLATION: future.result under lock
+
+    def ok_paths(self, items):
+        with self._lock:
+            self._q.put_nowait(1)  # ok: non-blocking
+            name = os.path.join("a", "b")  # ok: not a thread join
+            return ",".join(str(i) for i in items) + name  # ok: str join
+
+    def suppressed(self):
+        with self._lock:
+            # rplint: allow[RP11] — fixture: suppression case
+            self._q.put(self._SENTINEL)
+
+    def drain(self):
+        self._t.join(timeout=5.0)

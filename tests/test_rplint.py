@@ -1,11 +1,13 @@
-"""rplint (ISSUE r10, grown flow-sensitive in ISSUE 11): every rule
-against its known-bad fixture, the pragma grammar (continuation lines,
-multi-rule pragmas, stale detection), the registry drift check, the
-stable --json schema (v2: severity + unresolvable-emit accounting), the
-exit-code contract (findings→1, clean→0, internal error→2), baseline
-diffing, and — the acceptance gate — that the shipped tree lints clean
-through the real `cli lint` entry point with zero non-baselined
-findings."""
+"""rplint (ISSUE r10, grown flow-sensitive in ISSUE 11, concurrency-
+aware in ISSUE 12): every rule against its known-bad fixture, the
+pragma grammar (continuation lines, multi-rule pragmas, stale
+detection), the registry drift check, the stable --json schema (v3:
+severity + unresolvable-emit accounting), the exit-code contract
+(findings→1, clean→0, internal error→2), baseline diffing +
+--update-baseline rewriting, SARIF 2.1.0 output, the RP04/RP08 dedupe,
+and — the acceptance gate — that the shipped tree (including all four
+thread/queue substrates under RP10/RP11) lints clean through the real
+`cli lint` entry point with zero non-baselined findings."""
 
 import json
 import os
@@ -303,7 +305,7 @@ def test_cli_lint_exits_zero_and_json_schema(capsys):
     assert cli.main(["lint", "--json"]) == 0
     out = capsys.readouterr().out.strip()
     rec = json.loads(out)
-    assert rec["rplint"] == 2 and rec["ok"] is True
+    assert rec["rplint"] == 3 and rec["ok"] is True
     assert set(rec) == {
         "rplint", "root", "files", "findings", "counts", "suppressed",
         "unresolvable_emits", "ok",
@@ -549,16 +551,20 @@ def test_pragma_on_continuation_line():
 
 
 def test_pragma_two_rules_one_line_both_match():
+    # the missing daemon= (RP04) and the missing join (RP08) both
+    # anchor on the one-line statement; the ISSUE 12 dedupe drops only
+    # RP04's *no-join* duplicate, never its daemon finding
     src = (
         "import queue\nimport threading\n"
         "def f(x):\n"
         "    # rplint: allow[RP04,RP08] — fixture: one reason, two rules\n"
-        "    t = threading.Thread(target=print, daemon=True); t.start()\n"
+        "    t = threading.Thread(target=print); t.start()\n"
         "    return None\n"
     )
     fs = rplint.lint_source(src, "x.py")
     assert sorted(f.rule for f in fs) == ["RP04", "RP08"]
     assert all(f.suppressed for f in fs)
+    assert "daemon" in next(f for f in fs if f.rule == "RP04").message
 
 
 def test_stale_pragma_is_rp00():
@@ -873,3 +879,369 @@ def test_rp08_append_built_pool_joined_in_finally_is_clean():
     assert [f for f in fs if f.rule == "RP08"] == [], [
         f.message for f in fs
     ]
+
+
+# -- ISSUE 12: RP10 shared-state races / RP11 lock-order deadlocks -----------
+
+
+def test_rp10_fixture():
+    """Concurrency-module scoping: unlocked cross-role read/write,
+    one-side-only lock, write published after start(), and the
+    lock-consistency leg — each seeded exactly once; the ok-twins
+    (same-lock, queue handoff, init-only-dominates-start) silent."""
+    active, suppressed = _split(
+        _lint_fixture("rp10_bad.py", relpath="streaming.py")
+    )
+    assert [f.rule for f in active] == ["RP10"] * 4
+    msgs = [f.message for f in active]
+    joined = " | ".join(msgs)
+    assert "self._count of UnlockedTallies" in joined
+    assert "self._total of OneSideLocked" in joined
+    assert "self._late of WriteAfterStart" in joined
+    assert "written by role 'main' (__init__" in joined  # post-start write
+    assert "self._n of InconsistentNoThreads" in joined
+    assert "locked inconsistently" in joined
+    assert sum("with no common lock" in m for m in msgs) == 3
+    # the ok-twins produced nothing
+    for clean in ("LockedOk", "QueueHandoffOk", "InitOnlyOk"):
+        assert clean not in joined
+    assert [f.rule for f in suppressed] == ["RP10"]
+    assert suppressed[0].reason.startswith("fixture:")
+    # outside the concurrency modules the rule (and its pragma) stand down
+    assert _lint_fixture("rp10_bad.py") == []
+
+
+def test_rp11_fixture():
+    """Direct and call-level lock-order cycles plus the three blocking
+    classes (queue.put / thread.join / future.result) under a lock; the
+    ok-twins (acyclic order, put_nowait, str/path joins) silent."""
+    active, suppressed = _split(
+        _lint_fixture("rp11_bad.py", relpath="streaming.py")
+    )
+    assert [f.rule for f in active] == ["RP11"] * 5
+    msgs = [f.message for f in active]
+    joined = " | ".join(msgs)
+    assert sum("lock-order cycle" in m for m in msgs) == 2
+    assert "OrderCycle._a -> OrderCycle._b" in joined
+    assert "CallLevelCycle._x -> CallLevelCycle._y" in joined
+    assert "OrderOk" not in joined  # acyclic twin clean
+    assert "blocking .put()" in joined
+    assert "blocking .join()" in joined
+    assert "blocking .result()" in joined
+    assert [f.rule for f in suppressed] == ["RP11"]
+    assert suppressed[0].reason.startswith("fixture:")
+    assert _lint_fixture("rp11_bad.py") == []
+
+
+def test_rp10_rp11_shipped_concurrency_modules_pass():
+    """The acceptance gate for ISSUE 12: all four thread/queue
+    substrates plus telemetry/sharded-index/hashing pass RP10/RP11 with
+    every remaining suppression carrying a reasoned pragma — run
+    through lint_package so subclass roles resolve across modules."""
+    report = rplint.lint_package()
+    conc = [f for f in report["findings"] if f["rule"] in ("RP10", "RP11")]
+    active = [f for f in conc if not f["suppressed"]]
+    assert active == [], active
+    # the two accepted dispatcher-tally suppressions live in sketch.py
+    sup = [f for f in conc if f["suppressed"]]
+    assert {f["path"] for f in sup} == {"models/sketch.py"}
+    assert all(f["reason"] for f in sup)
+    assert {f["rule"] for f in sup} == {"RP10", "RP11"}
+
+
+def test_rp10_telemetry_run_token_lock_regression():
+    """The configure() fix (ISSUE 12): rebinding _RUN_TOKEN without
+    _SPAN_LOCK while _new_span_id reads it under the lock is exactly
+    the inconsistent-locking class RP10's module-global leg flags."""
+    import ast as _ast
+
+    from randomprojection_tpu.analysis import flowrules
+
+    bad = (
+        "import threading\n"
+        "_LOCK = threading.Lock()\n"
+        "_TOKEN = '0'\n"
+        "def reconfigure():\n"
+        "    global _TOKEN\n"
+        "    _TOKEN = 'fresh'\n"
+        "def read_id():\n"
+        "    with _LOCK:\n"
+        "        return _TOKEN + '-1'\n"
+    )
+    fs = flowrules.rule_rp10(_ast.parse(bad), "utils/telemetry.py")
+    assert len(fs) == 1 and "module global _TOKEN" in fs[0][1]
+    assert "locked inconsistently" in fs[0][1]
+    # the shipped telemetry module is clean (the fix holds the lock)
+    src = open(os.path.join(
+        rplint.package_root(), "utils", "telemetry.py"
+    )).read()
+    fs = rplint.lint_source(src, "utils/telemetry.py")
+    assert [f for f in fs if f.rule in ("RP10", "RP11")] == [], [
+        f.message for f in fs
+    ]
+
+
+def test_rp10_subclass_roles_resolve_through_index():
+    """A subclass hook in one file joins the thread roles its base
+    class constructs in another (the ShardedTopKServer shape): the
+    dispatcher-written attribute read by main-role stats() is flagged
+    in the SUBCLASS's file, and guarding both sides with the same lock
+    clears it."""
+    import ast as _ast
+
+    from randomprojection_tpu.analysis import cfg as cfgmod
+    from randomprojection_tpu.analysis import flowrules
+
+    base_src = (
+        "import threading\n"
+        "class Base:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._run, daemon=True)\n"
+        "        self._t.start()\n"
+        "    def _run(self):\n"
+        "        self._hook()\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"
+    )
+    sub_src = (
+        "from randomprojection_tpu.models.sketch import Base\n"
+        "class Sub(Base):\n"
+        "    def __init__(self):\n"
+        "        self._tally = 0\n"
+        "        super().__init__()\n"
+        "    def _hook(self):\n"
+        "        self._tally += 1\n"
+        "    def stats(self):\n"
+        "        return self._tally\n"
+    )
+    idx = cfgmod.PackageIndex()
+    idx.add(cfgmod.index_module("models/sketch.py", _ast.parse(base_src)))
+    idx.add(cfgmod.index_module(
+        "serving/server.py", _ast.parse(sub_src)
+    ))
+    fs = flowrules.rule_rp10(
+        _ast.parse(sub_src), "serving/server.py", index=idx
+    )
+    assert len(fs) == 1, fs
+    assert "self._tally" in fs[0][1] and "self._run" in fs[0][1]
+    # same shape with both sides under one lock: clean
+    locked_sub = sub_src.replace(
+        "        self._tally = 0\n",
+        "        import threading\n"
+        "        self._tally = 0\n"
+        "        self._lk = threading.Lock()\n",
+    ).replace(
+        "        self._tally += 1\n",
+        "        with self._lk:\n"
+        "            self._tally += 1\n",
+    ).replace(
+        "        return self._tally\n",
+        "        with self._lk:\n"
+        "            return self._tally\n",
+    )
+    idx2 = cfgmod.PackageIndex()
+    idx2.add(cfgmod.index_module("models/sketch.py", _ast.parse(base_src)))
+    idx2.add(cfgmod.index_module(
+        "serving/server.py", _ast.parse(locked_sub)
+    ))
+    assert flowrules.rule_rp10(
+        _ast.parse(locked_sub), "serving/server.py", index=idx2
+    ) == []
+
+
+def test_rp04_rp08_dedupe_one_bug_one_report():
+    """ISSUE 12 satellite: a thread RP08 flow-checks (started,
+    non-escaping) stands RP04's per-line no-join heuristic down — the
+    missing join reports exactly once (as the flow finding)."""
+    src = (
+        "import threading\n"
+        "def leak(work):\n"
+        "    t = threading.Thread(target=print, daemon=True)\n"
+        "    t.start()\n"  # no .join( anywhere in this module
+        "    work()\n"
+    )
+    fs = rplint.lint_source(src, "x.py")
+    rules = [f.rule for f in fs]
+    assert rules == ["RP08"], [(f.rule, f.message) for f in fs]
+    assert "never joined in this function" in fs[0].message
+    # a module-level thread (not covered by the flow check) still gets
+    # the per-line heuristic — the dedupe never widens a blind spot
+    nojoin = _lint_fixture("rp04_nojoin.py")
+    assert [f.rule for f in nojoin] == ["RP04"]
+    # and rp08_bad.py (the regression target) reports each seeded bug
+    # exactly once: RP08 findings only, no RP04 duplicates
+    active, _sup = _split(_lint_fixture("rp08_bad.py"))
+    assert [f.rule for f in active] == ["RP08"] * 4
+
+
+def test_sarif_output(tmp_path, capsys):
+    """--sarif emits a SARIF 2.1.0 log: rule metadata, one result per
+    finding with the region line, info → note level, and
+    pragma-suppressed findings carrying an inSource suppression."""
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "import queue\n"
+        "q = queue.Queue()\n"
+        "# rplint: allow[RP04] — test: bounded upstream\n"
+        "q2 = queue.Queue()\n"
+    )
+    sarif_path = tmp_path / "out.sarif"
+    assert cli.main(["lint", "--sarif", str(sarif_path), str(bad)]) == 1
+    capsys.readouterr()
+    log = json.loads(sarif_path.read_text())
+    assert log["version"] == "2.1.0"
+    assert "sarif-2.1.0" in log["$schema"]
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "rplint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"RP00", "RP04", "RP10", "RP11"} <= rule_ids
+    results = run["results"]
+    assert len(results) == 2
+    by_sup = {bool(r.get("suppressions")): r for r in results}
+    active, sup = by_sup[False], by_sup[True]
+    assert active["ruleId"] == "RP04" and active["level"] == "error"
+    loc = active["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("seeded.py")
+    assert loc["region"]["startLine"] == 2
+    assert sup["suppressions"][0]["kind"] == "inSource"
+    assert sup["suppressions"][0]["justification"] == "test: bounded upstream"
+
+
+def test_update_baseline_rewrites_in_place(tmp_path, capsys):
+    """--update-baseline: first run creates the baseline from the
+    current findings (exit 0), the diffed run then passes, and after
+    the fix a second update prunes the stale entry."""
+    bad = tmp_path / "seeded.py"
+    bad.write_text("import queue\nq = queue.Queue()\n")
+    basefile = tmp_path / "base.json"
+    # without --update-baseline a missing baseline is an internal error
+    assert cli.main(["lint", "--baseline", str(basefile), str(bad)]) == 2
+    capsys.readouterr()
+    assert cli.main(["lint", "--baseline", str(basefile),
+                     "--update-baseline", str(bad)]) == 0
+    out = capsys.readouterr().out
+    assert "baseline updated" in out and "1 new finding(s) accepted" in out
+    base = json.loads(basefile.read_text())
+    assert base["rplint"] == 3
+    assert [f["rule"] for f in base["findings"]] == ["RP04"]
+    # the accepted finding now passes the diffed gate
+    assert cli.main(["lint", "--baseline", str(basefile), str(bad)]) == 0
+    capsys.readouterr()
+    # fix the violation: the stale entry is pruned by the next update
+    bad.write_text("import queue\nq = queue.Queue(maxsize=4)\n")
+    assert cli.main(["lint", "--baseline", str(basefile),
+                     "--update-baseline", str(bad)]) == 0
+    out = capsys.readouterr().out
+    assert "1 stale entr(ies) pruned" in out
+    base2 = json.loads(basefile.read_text())
+    assert base2["findings"] == []
+    # --update-baseline without --baseline is a usage error (exit 2)
+    assert cli.main(["lint", "--update-baseline", str(bad)]) == 2
+    assert "requires --baseline" in capsys.readouterr().err
+
+
+def test_rp10_same_role_unlocked_read_does_not_void_locked_pair():
+    """Review fix (same PR): races are judged per CROSS-ROLE pair — an
+    unlocked read on the writer's own thread cannot race the write, so
+    it must not fail a properly locked cross-role pair."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lk = threading.Lock()\n"
+        "        self._n = 0\n"
+        "        self._t = threading.Thread(target=self._run, "
+        "daemon=True)\n"
+        "        self._t.start()\n"
+        "    def _run(self):\n"
+        "        with self._lk:\n"
+        "            self._n += 1\n"
+        "        print(self._n)  # same-role read: cannot race _run\n"
+        "    def read(self):\n"
+        "        with self._lk:\n"
+        "            return self._n\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"
+    )
+    fs = rplint.lint_source(src, "streaming.py")
+    assert [f for f in fs if f.rule == "RP10"] == [], [
+        f.message for f in fs
+    ]
+    # the cross-role pair going bare is still caught
+    bad = src.replace(
+        "    def read(self):\n        with self._lk:\n"
+        "            return self._n\n",
+        "    def read(self):\n        return self._n\n",
+    )
+    fs = rplint.lint_source(bad, "streaming.py")
+    assert any(f.rule == "RP10" for f in fs), [f.message for f in fs]
+
+
+def test_rp11_rlock_reentry_is_not_a_self_deadlock():
+    """Review fix (same PR): re-entering a threading.RLock is legal —
+    the self-edge finding applies to plain Lock only (order cycles
+    through an RLock still count)."""
+    rlock = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lk = threading.RLock()\n"
+        "    def outer(self):\n"
+        "        with self._lk:\n"
+        "            return self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._lk:\n"
+        "            return 1\n"
+    )
+    fs = rplint.lint_source(rlock, "streaming.py")
+    assert [f for f in fs if f.rule == "RP11"] == [], [
+        f.message for f in fs
+    ]
+    plain = rlock.replace("threading.RLock()", "threading.Lock()")
+    fs = rplint.lint_source(plain, "streaming.py")
+    assert any(
+        f.rule == "RP11" and "not reentrant" in f.message for f in fs
+    ), [f.message for f in fs]
+
+
+def test_rp11_string_join_on_variable_separator_is_not_blocking():
+    """Review fix (same PR): sep.join(parts) is a string join — only
+    the thread-join call shapes (no positional args, or one numeric
+    timeout) count as blocking under a lock."""
+    src = (
+        "import threading\n"
+        "_L = threading.Lock()\n"
+        "def render(sep, parts):\n"
+        "    with _L:\n"
+        "        return sep.join(parts)\n"
+    )
+    fs = rplint.lint_source(src, "streaming.py")
+    assert [f for f in fs if f.rule == "RP11"] == [], [
+        f.message for f in fs
+    ]
+    timeout_join = (
+        "import threading\n"
+        "_L = threading.Lock()\n"
+        "def halt(t):\n"
+        "    with _L:\n"
+        "        t.join(5.0)\n"
+    )
+    fs = rplint.lint_source(timeout_join, "streaming.py")
+    assert any(
+        f.rule == "RP11" and "blocking .join()" in f.message for f in fs
+    ), [f.message for f in fs]
+
+
+def test_ci_workflow_runs_lint_ci_and_fast_tier1():
+    """ISSUE 12 satellite: the committed GitHub workflow gates pushes
+    and PRs on `make lint-ci` plus a budgeted 'not slow' tier-1 run."""
+    wf = os.path.join(
+        os.path.dirname(rplint.package_root()),
+        ".github", "workflows", "ci.yml",
+    )
+    with open(wf) as fh:
+        text = fh.read()
+    assert "make lint-ci" in text
+    assert "-m 'not slow'" in text
+    assert "pull_request" in text and "push" in text
+    assert "timeout" in text  # the test-time budget
