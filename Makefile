@@ -50,16 +50,23 @@
 #                      single-worker run AND a staged --ingest-workers
 #                      run) and run `doctor` on them; asserts the staged
 #                      run's report computes a bubble fraction
+#   make live-smoke    live observability plane (ISSUE r17): a real
+#                      stream-bench with --metrics-port, one HTTP scrape
+#                      taken WHILE it runs, asserted to be valid
+#                      OpenMetrics with histogram buckets + the new
+#                      quantile summary lines and a nonzero span-derived
+#                      live gauge (spans flowed through the in-process
+#                      subscriber with no JSONL file involved)
 
 SHELL := /bin/bash
 PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
 .PHONY: verify lint lint-ci tier1 kernel-smoke transform-smoke shard-smoke \
-        recover-smoke doctor-smoke
+        recover-smoke doctor-smoke live-smoke
 
 verify: lint lint-ci kernel-smoke transform-smoke shard-smoke recover-smoke \
-        tier1 doctor-smoke
+        live-smoke tier1 doctor-smoke
 
 lint:
 	$(PYTHON) -m randomprojection_tpu lint
@@ -128,6 +135,9 @@ tier1:
 	rc=$${PIPESTATUS[0]}; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
+
+live-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m randomprojection_tpu.utils.live_smoke
 
 doctor-smoke:
 	rm -rf $(SMOKE_DIR) && mkdir -p $(SMOKE_DIR)

@@ -88,7 +88,13 @@ def main():
         topk_kernels,
     )
     from randomprojection_tpu.parallel import distributed
-    from randomprojection_tpu.utils import observability, telemetry, trace_report
+    from randomprojection_tpu.utils import (
+        metrics_server,
+        observability,
+        telemetry,
+        trace_report,
+    )
+    import randomprojection_tpu.loadgen as loadgen
 
     for title, mod in [
         ("`randomprojection_tpu.streaming`", streaming),
@@ -103,6 +109,8 @@ def main():
         ("`randomprojection_tpu.utils.observability`", observability),
         ("`randomprojection_tpu.utils.telemetry`", telemetry),
         ("`randomprojection_tpu.utils.trace_report`", trace_report),
+        ("`randomprojection_tpu.utils.metrics_server`", metrics_server),
+        ("`randomprojection_tpu.loadgen`", loadgen),
         ("`randomprojection_tpu.analysis.rplint`", rplint),
         ("`randomprojection_tpu.analysis.cfg`", analysis_cfg),
         ("`randomprojection_tpu.analysis.flowrules`", analysis_flowrules),

@@ -200,6 +200,11 @@ HOT_MODULES = (
     "models/sketch.py",
     "serving/sharded_index.py",
     "serving/server.py",
+    # r17 live plane: the scrape handler runs while the pipeline serves,
+    # and the loadgen submit loop IS an open-loop latency measurement —
+    # a hidden host sync in either falsifies what they observe
+    "utils/metrics_server.py",
+    "loadgen.py",
 )
 # RP06: modules on the pipeline/serving path where a swallowed error
 # strands a stream, a future, or a telemetry file
@@ -233,6 +238,11 @@ CONCURRENCY_MODULES = (
     "serving/sharded_index.py",
     "utils/telemetry.py",
     "ops/hashing.py",
+    # r17 live plane: subscriber dispatch threads (telemetry, above),
+    # the metrics HTTP serving thread, and loadgen's completion-callback
+    # lock are all born under RP10/RP11
+    "utils/metrics_server.py",
+    "loadgen.py",
 )
 # RP05: Generator-construction surface of np.random that stays legal
 RNG_FACTORY_OK = frozenset(

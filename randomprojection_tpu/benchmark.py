@@ -1014,6 +1014,10 @@ def measure_config4_topk(preset: str = "full") -> dict:
             "merges": merges,
             "merge_wall_s": round(merge_wall, 6),
             "replica_batches": sh_stats["replica_batches"],
+            # r17 per-request tail latency (enqueue→complete quantiles
+            # over warm + timed rounds of THIS process — the honest
+            # client-observed number next to the throughput)
+            "latency_quantiles": sh_stats.get("latency"),
             "executed_tflops": round(sh_executed, 1),
             "timing_suspect": bool(sh_executed > 2 * V5E_PEAK_TFLOPS),
         }
@@ -1035,6 +1039,9 @@ def measure_config4_topk(preset: str = "full") -> dict:
         "server_request_rows": req_rows,
         "server_max_batch": max_batch,
         "server_rows_per_batch_mean": rows_per_batch,
+        # r17 per-request tail latency through the micro-batcher
+        # (enqueue→complete quantiles over warm + timed rounds)
+        "server_latency_quantiles": end_stats.get("latency"),
         "elapsed_s": round(server_elapsed, 4),
         "single_stream_elapsed_s": round(elapsed, 4),
         "executed_tflops": round(server_executed, 1),

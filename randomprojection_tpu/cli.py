@@ -37,6 +37,20 @@ import sys
 
 import numpy as np
 
+# the live MetricsServer while a --metrics-port command runs (set and
+# cleared by main(); commands with per-run registries register them as
+# scrape sources through _register_metrics_source, and the live-smoke
+# harness reads the bound port from here)
+_METRICS_SERVER = None
+
+
+def _register_metrics_source(fn) -> None:
+    """Attach a snapshot source (e.g. ``stats.registry.snapshot``) to
+    the live metrics endpoint when one is running; no-op otherwise."""
+    server = _METRICS_SERVER
+    if server is not None:
+        server.add_source(fn)
+
 
 def _add_common(p):
     p.add_argument("--backend", default="auto",
@@ -108,6 +122,16 @@ def _add_observability(p):
                         "text exposition of the process metrics registry "
                         "(counters, gauges, stage-wall histograms) to "
                         "this file — pure text, no HTTP server")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve a LIVE OpenMetrics endpoint at "
+                        "http://127.0.0.1:PORT/metrics for the duration "
+                        "of the command (0 = ephemeral port, printed to "
+                        "stderr): process registry counters/gauges, "
+                        "latency histograms WITH p50/p90/p99/p99.9 "
+                        "summaries, and a rolling LiveAggregator window "
+                        "of span walls + time-weighted queue depth fed "
+                        "by an in-process telemetry subscriber; poll it "
+                        "with 'doctor --live HOST:PORT'")
 
 
 def _positive_int(v: str) -> int:
@@ -201,13 +225,28 @@ def build_parser():
                     "fallbacks, clamps) and the regression-tripwire "
                     "status from the newest committed bench record.  "
                     "Tolerates crashed runs: torn tails and orphaned "
-                    "spans are counted, not fatal.",
+                    "spans are counted, not fatal.  With --live "
+                    "HOST:PORT it instead polls a --metrics-port "
+                    "endpoint and renders a refreshing live view.",
     )
-    q.add_argument("telemetry", metavar="TELEMETRY_JSONL",
-                   help="event file written by --telemetry-jsonl")
+    q.add_argument("telemetry", nargs="?", metavar="TELEMETRY_JSONL",
+                   help="event file written by --telemetry-jsonl "
+                        "(omit with --live)")
     q.add_argument("--json", action="store_true",
                    help="print the report as one JSON object instead of "
-                        "the rendered text")
+                        "the rendered text (with --live: one JSON line "
+                        "per poll)")
+    q.add_argument("--live", default=None, metavar="HOST:PORT",
+                   help="poll the live metrics endpoint a --metrics-port "
+                        "run is serving and render a refreshing terminal "
+                        "view: queue depths, rolling per-stage span "
+                        "walls, serve-latency quantiles, degraded-"
+                        "counter rates")
+    q.add_argument("--interval", type=float, default=1.0,
+                   help="--live poll interval in seconds")
+    q.add_argument("--iterations", type=int, default=0, metavar="N",
+                   help="--live: stop after N polls (0 = until "
+                        "interrupted)")
 
     q = sub.add_parser(
         "lint",
@@ -333,6 +372,75 @@ def build_parser():
                         "batches route round-robin across them "
                         "(serving.ShardedTopKServer)")
     q.add_argument("--seed", type=int, default=0)
+    _add_observability(q)
+
+    q = sub.add_parser(
+        "loadgen",
+        help="open-loop load generator -> per-label tail-latency SLO "
+             "record (topk_slo)",
+        description="Drive a ShardedTopKServer with an OPEN-loop "
+                    "arrival schedule (Poisson or bursty, mixed request "
+                    "sizes, fixed client labels — fully determined by "
+                    "--seed, so the identical seed reproduces the "
+                    "identical schedule) and emit a 'topk_slo' record "
+                    "carrying per-client-label p50/p90/p99/p99.9 "
+                    "latency tables, rejects, and the schedule digest.  "
+                    "Unlike topk-bench's closed-loop clients, a slow "
+                    "server here does NOT slow its own offered load — "
+                    "queueing collapse shows up in the tail instead of "
+                    "hiding in the rate.",
+    )
+    q.add_argument("--index-codes", type=_positive_int, default=1 << 14,
+                   help="rows in the resident code index")
+    q.add_argument("--code-bytes", type=_positive_int, default=32,
+                   help="packed code width (bytes/row)")
+    q.add_argument("--m", type=_positive_int, default=16,
+                   help="neighbors per query")
+    q.add_argument("--shards", type=_positive_int, default=1,
+                   help="row-shard the corpus over this many shard "
+                        "devices (serving.ShardedSimHashIndex)")
+    q.add_argument("--replicas", type=_positive_int, default=1,
+                   help="replica groups; coalesced batches route "
+                        "round-robin across them")
+    q.add_argument("--topk-impl", default="auto",
+                   choices=["auto", "fused", "scan"],
+                   help="query_topk device path per shard")
+    q.add_argument("--rate", type=float, default=50.0, metavar="QPS",
+                   help="mean offered request rate (requests/s)")
+    q.add_argument("--duration", type=float, default=5.0, metavar="SEC",
+                   help="schedule length in seconds")
+    q.add_argument("--arrival", default="poisson",
+                   choices=["poisson", "bursty"],
+                   help="arrival process: memoryless Poisson, or a "
+                        "mean-preserving on/off burst cycle "
+                        "(--burst-factor/--burst-fraction/--burst-period)")
+    q.add_argument("--request-rows", default="16,64,256",
+                   metavar="R1,R2,...",
+                   help="request-size mix: query rows drawn uniformly "
+                        "from this comma list")
+    q.add_argument("--labels", default="tenant-a,tenant-b",
+                   metavar="L1,L2,...",
+                   help="client labels assigned (seeded-random) per "
+                        "request; the record carries one SLO table per "
+                        "label")
+    q.add_argument("--burst-factor", type=float, default=8.0,
+                   help="bursty: ON-phase rate multiplier")
+    q.add_argument("--burst-fraction", type=float, default=0.125,
+                   help="bursty: fraction of each period that is ON")
+    q.add_argument("--burst-period", type=float, default=1.0,
+                   metavar="SEC", help="bursty: cycle period")
+    q.add_argument("--server-batch", type=_positive_int, default=8192,
+                   help="ShardedTopKServer max coalesced rows/dispatch")
+    q.add_argument("--server-delay-ms", type=float, default=2.0,
+                   help="ShardedTopKServer straggler wait")
+    q.add_argument("--max-pending", type=_positive_int, default=8192,
+                   help="submit-queue bound (requests); beyond it "
+                        "submissions are shed and counted as rejects")
+    q.add_argument("--seed", type=int, default=0)
+    q.add_argument("--out", default=None, metavar="PATH",
+                   help="also write the topk_slo record (one JSON "
+                        "object) to this file — the bench artifact "
+                        "ROADMAP #4/#5 scenarios reuse")
     _add_observability(q)
 
     q = sub.add_parser("stream-bench", help="host-streamed throughput")
@@ -462,6 +570,7 @@ def cmd_project(args):
         X = restore_void_dtype(np.load(args.input, mmap_mode="r"))
     source = ArraySource(X, args.batch_rows)
     stats = StreamStats(log_every=10)
+    _register_metrics_source(stats.registry.snapshot)
     # np.save appends .npy itself; normalize once so the JSON summary and
     # the memmap path always name the file that actually exists
     out_path = args.output if args.output.endswith(".npy") else args.output + ".npy"
@@ -573,6 +682,78 @@ def _write_openmetrics(args, *extra_snapshots) -> None:
     args.openmetrics = None
 
 
+def _cmd_doctor_live(args) -> int:
+    """``doctor --live HOST:PORT``: poll the live metrics endpoint and
+    render a refreshing terminal view (see utils/metrics_server.py)."""
+    import time
+
+    from randomprojection_tpu.utils import metrics_server
+
+    host, _, port_s = args.live.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        port = -1
+    if not host or not 0 < port < 65536:
+        raise SystemExit(
+            f"--live wants HOST:PORT (e.g. 127.0.0.1:9100), got "
+            f"{args.live!r}"
+        )
+    if args.interval <= 0:
+        raise SystemExit(f"--interval must be > 0, got {args.interval}")
+    prev = None
+    poll = 0
+    consecutive_failures = 0
+    while True:
+        poll += 1
+        try:
+            text = metrics_server.fetch_metrics(
+                host, port, timeout=max(args.interval, 1.0)
+            )
+        except OSError as e:
+            # a FIRST-poll failure means the endpoint was never there;
+            # later ones are tolerated briefly — one timed-out scrape
+            # (the serving process momentarily compile/GIL-bound) must
+            # not kill a dashboard that has been live for hours
+            consecutive_failures += 1
+            if poll == 1 or consecutive_failures >= 5:
+                raise SystemExit(
+                    f"live endpoint {args.live} unreachable"
+                    + (
+                        f" ({consecutive_failures} consecutive "
+                        "failed polls)" if poll > 1 else ""
+                    )
+                    + f": {e} — is the serving process running with "
+                    "--metrics-port?"
+                )
+            print(
+                f"live doctor: poll #{poll} failed ({e}); retrying",
+                file=sys.stderr,
+            )
+            if args.iterations and poll >= args.iterations:
+                return 0
+            time.sleep(args.interval)
+            continue
+        consecutive_failures = 0
+        plain, labeled = metrics_server.parse_openmetrics(text)
+        if args.json:
+            print(metrics_server.live_snapshot_json(plain, labeled))
+        else:
+            if sys.stdout.isatty() and poll > 1:
+                print("\x1b[2J\x1b[H", end="")
+            print(
+                metrics_server.render_live(
+                    plain, labeled, prev, interval_s=args.interval,
+                    endpoint=args.live, poll=poll,
+                ),
+                end="", flush=True,
+            )
+        prev = plain
+        if args.iterations and poll >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
 def cmd_doctor(args):
     import os
 
@@ -581,6 +762,12 @@ def cmd_doctor(args):
         render_report,
     )
 
+    if getattr(args, "live", None):
+        return _cmd_doctor_live(args)
+    if not args.telemetry:
+        raise SystemExit(
+            "doctor wants a TELEMETRY_JSONL file (or --live HOST:PORT)"
+        )
     if not os.path.exists(args.telemetry):
         raise SystemExit(f"no such telemetry file: {args.telemetry}")
     try:
@@ -837,6 +1024,85 @@ def cmd_topk_bench(args):
     _write_openmetrics(args)
 
 
+def cmd_loadgen(args):
+    """Open-loop SLO measurement against a ``ShardedTopKServer`` (see
+    loadgen.py): deterministic seeded arrival schedule, per-label
+    p50/p90/p99/p99.9 tables, printed as the final stdout line (the
+    ``topk_slo`` record) and optionally written to ``--out``."""
+    from randomprojection_tpu import loadgen
+    from randomprojection_tpu.serving import (
+        ShardedSimHashIndex,
+        ShardedTopKServer,
+    )
+
+    def _csv(text, cast, flag):
+        try:
+            vals = [cast(v.strip()) for v in text.split(",") if v.strip()]
+        except ValueError:
+            vals = []
+        if not vals:
+            raise SystemExit(f"{flag} wants a comma list, got {text!r}")
+        return vals
+
+    request_rows = _csv(args.request_rows, int, "--request-rows")
+    labels = _csv(args.labels, str, "--labels")
+    try:
+        schedule = loadgen.build_schedule(
+            seed=args.seed, duration_s=args.duration, rate_qps=args.rate,
+            arrival=args.arrival, request_rows=request_rows,
+            labels=labels, burst_factor=args.burst_factor,
+            burst_fraction=args.burst_fraction,
+            burst_period_s=args.burst_period,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if not schedule:
+        raise SystemExit(
+            f"empty schedule: --rate {args.rate} over --duration "
+            f"{args.duration}s produced no arrivals — raise one of them"
+        )
+    rng = np.random.default_rng(args.seed)
+    codes = rng.integers(
+        0, 256, size=(args.index_codes, args.code_bytes), dtype=np.uint8
+    )
+    groups = [
+        ShardedSimHashIndex(
+            codes, n_shards=args.shards, topk_impl=args.topk_impl
+        )
+        for _ in range(args.replicas)
+    ]
+    server = ShardedTopKServer(
+        groups, args.m, max_batch=args.server_batch,
+        max_delay_s=args.server_delay_ms / 1e3,
+        max_pending=args.max_pending,
+    )
+    try:
+        record = loadgen.run(
+            server, schedule, code_bytes=args.code_bytes,
+            seed=args.seed, warmup_rows=max(request_rows),
+        )
+    finally:
+        server.close()
+    record.update({
+        "seed": args.seed,
+        "arrival": args.arrival,
+        "rate_qps": args.rate,
+        "duration_s": args.duration,
+        "request_rows": request_rows,
+        "index_codes": args.index_codes,
+        "code_bytes": args.code_bytes,
+        "m": args.m,
+        "shards": args.shards,
+        "replicas": args.replicas,
+    })
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(record, f)
+    _write_openmetrics(args)
+    # the record is the FINAL stdout line (tail-safe, like the bench)
+    print(json.dumps(record))
+
+
 def cmd_stream_bench(args):
     """Host-streamed rows/s: includes h2d (PCIe) — the honest streamed
     number, which SURVEY.md §7 R3 predicts is transfer-bound.  The
@@ -889,6 +1155,7 @@ def cmd_stream_bench(args):
     # to it could prime this box's device call cache for the timed stream)
     est.transform(np.negative(template[: min(args.batch_rows, args.rows) or 1]))
     stats = StreamStats()
+    _register_metrics_source(stats.registry.snapshot)
     timed_source = _wrap_prefetch(source, est, args, stats)
     t0 = time.perf_counter()
     with profile_trace(args.profile_dir):
@@ -961,22 +1228,62 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_disable_jit", True)
-    rv = {
-        "jl-dim": cmd_jl_dim,
-        "info": cmd_info,
-        "project": cmd_project,
-        "bench": cmd_bench,
-        "stream-bench": cmd_stream_bench,
-        "topk-bench": cmd_topk_bench,
-        "recover": cmd_recover,
-        "doctor": cmd_doctor,
-        "report": cmd_doctor,  # alias
-        "lint": cmd_lint,
-    }[args.cmd](args)
-    # fallback for commands that didn't write their own (e.g. bench);
-    # project/stream-bench merge their StreamStats registry in and
-    # consume the flag first
-    _write_openmetrics(args)
+    live = None
+    if getattr(args, "metrics_port", None) is not None:
+        # live observability plane (r17): a LiveAggregator subscribed to
+        # the in-process event stream + an HTTP /metrics endpoint, both
+        # for the duration of the command.  The endpoint line goes to
+        # STDERR — stdout keeps the bench/loadgen final-line contract.
+        if args.metrics_port < 0 or args.metrics_port > 65535:
+            raise SystemExit(
+                f"--metrics-port must be 0..65535, got {args.metrics_port}"
+            )
+        from randomprojection_tpu.utils import metrics_server, telemetry
+
+        agg = telemetry.LiveAggregator()
+        # bind the port FIRST: MetricsServer is the failure-prone step
+        # (address in use), and a subscribe before a failed bind would
+        # leak a registered subscription no finally could clean up —
+        # keeping telemetry active process-wide for in-process callers
+        server = metrics_server.MetricsServer(
+            port=args.metrics_port, aggregator=agg
+        )
+        try:
+            sub = telemetry.subscribe(agg, maxsize=4096,
+                                      name="live-aggregator")
+        except BaseException:
+            server.close()
+            raise
+        live = (server, sub)
+        global _METRICS_SERVER
+        _METRICS_SERVER = server
+        print(f"metrics: serving {server.url}", file=sys.stderr)
+    try:
+        rv = {
+            "jl-dim": cmd_jl_dim,
+            "info": cmd_info,
+            "project": cmd_project,
+            "bench": cmd_bench,
+            "stream-bench": cmd_stream_bench,
+            "topk-bench": cmd_topk_bench,
+            "loadgen": cmd_loadgen,
+            "recover": cmd_recover,
+            "doctor": cmd_doctor,
+            "report": cmd_doctor,  # alias
+            "lint": cmd_lint,
+        }[args.cmd](args)
+        # fallback for commands that didn't write their own (e.g. bench);
+        # project/stream-bench merge their StreamStats registry in and
+        # consume the flag first
+        _write_openmetrics(args)
+    finally:
+        if live is not None:
+            from randomprojection_tpu.utils import telemetry
+
+            server, sub = live
+            _METRICS_SERVER = None
+            server.close()
+            telemetry.unsubscribe(sub)
     return rv
 
 

@@ -1198,6 +1198,17 @@ class SimHashIndex:
         return fn
 
 
+def _metric_label(label) -> str:
+    """Sanitize a client label for use inside a registry metric name
+    (``serve.latency.<server>.client.<label>``): any character that is
+    not alphanumeric / ``_`` / ``.`` / ``-`` becomes ``_``, capped at
+    64 chars so a hostile label cannot explode the metric namespace."""
+    import re as _re
+
+    s = _re.sub(r"[^A-Za-z0-9_.\-]", "_", str(label))[:64]
+    return s or "_"
+
+
 class TopKServer:
     """Micro-batching front-end for ``SimHashIndex.query_topk`` (the
     config-4 serving path under concurrent traffic).
@@ -1242,13 +1253,28 @@ class TopKServer:
     nobody is draining: once ``max_pending`` requests are waiting,
     ``submit()`` raises ``RuntimeError`` (counted in
     ``serve.topk.rejects``) instead of enqueueing.
+
+    Tail latency (r17): every request is stamped at enqueue, dispatch
+    and completion; the enqueue→complete total (plus the queue-wait and
+    on-device components) feeds HDR-style log2-bucket histograms on the
+    process registry, keyed per SERVER NAME (``serve.latency.<name>``,
+    ``name=`` at construction — two servers sharing a name share
+    tallies, like the ``serve.topk.*`` counters always have) and, when
+    a request carries a client ``label``, per label
+    (``serve.latency.<name>.client.<label>``).  Quantiles
+    (p50/p90/p99/p99.9) come out of ``stats()["latency"]``, the
+    OpenMetrics exposition and the live metrics endpoint — the first
+    honest per-request tail numbers for the serving tier.  Each
+    completion also emits a ``serve.latency.request`` event (when
+    telemetry is active) for the doctor's latency section.
     """
 
     _SENTINEL = object()
 
     def __init__(self, index: "SimHashIndex", m: int, *,
                  max_batch: int = 8192, max_delay_s: float = 0.002,
-                 max_pending: int = 8192, start: bool = True):
+                 max_pending: int = 8192, name: str = "topk",
+                 start: bool = True):
         if not isinstance(m, numbers.Integral) or m <= 0:
             raise ValueError(f"m must be a positive int, got {m!r}")
         if not isinstance(max_batch, numbers.Integral) or max_batch < 1:
@@ -1263,11 +1289,17 @@ class TopKServer:
             raise ValueError(
                 f"max_pending must be a positive int, got {max_pending!r}"
             )
+        if not isinstance(name, str) or not name:
+            raise ValueError(f"name must be a non-empty str, got {name!r}")
         self.index = index
         self.m = int(m)
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_s)
         self.max_pending = int(max_pending)
+        self.name = name
+        # latency histogram key prefix on the PROCESS registry (shared
+        # across same-named servers by design, see class doc)
+        self._lat_name = f"serve.latency.{name}"
         import queue as _queue
 
         # bounded: a stalled drain rejects new submits (see class doc)
@@ -1326,13 +1358,21 @@ class TopKServer:
 
     # -- request surface ----------------------------------------------------
 
-    def submit(self, codes):
+    def submit(self, codes, *, label: Optional[str] = None):
         """Enqueue one request of packed codes ``(rows, n_bytes)`` (a 1-D
         code is one row) and return a Future resolving to that request's
         ``(dist, idx)`` — each ``(rows, m_eff)`` int32, identical to a
-        direct ``query_topk`` call."""
+        direct ``query_topk`` call.  ``label`` tags the request with a
+        client identity for the per-label latency histograms
+        (``serve.latency.<server>.client.<label>``); sanitized to
+        metric-name-safe characters."""
+        import time as _time
+
         from concurrent.futures import Future
 
+        t_enq = _time.perf_counter()
+        if label is not None:
+            label = _metric_label(label)
         codes = np.asarray(codes, dtype=np.uint8)
         if codes.ndim == 1:
             codes = codes[None, :]
@@ -1359,24 +1399,29 @@ class TopKServer:
                     f"{self.max_pending} requests waiting; the dispatcher "
                     "is not draining — device hung or server overloaded)"
                 )
-            self._q.put_nowait((codes, fut))
+            self._q.put_nowait((codes, fut, label, t_enq))
         return fut
 
-    def query(self, codes):
+    def query(self, codes, *, label: Optional[str] = None):
         """Blocking convenience: ``submit(codes).result()``."""
-        return self.submit(codes).result()
+        return self.submit(codes, label=label).result()
 
     def stats(self) -> dict:
-        """Coalescing tallies: served batches/requests/queries and the
-        mean rows per coalesced dispatch."""
+        """Coalescing tallies: served batches/requests/queries, the
+        mean rows per coalesced dispatch, and (once any request has
+        completed) the enqueue→complete latency quantiles."""
         # rplint: allow[RP10] — dispatcher-private monotone int tallies: rebinds are GIL-atomic and stats() is a best-effort snapshot (cross-field staleness acceptable by contract, see the __init__ comment)
         b, r, q = self._batches, self._requests, self._queries
-        return {
+        out = {
             "batches": b,
             "requests": r,
             "queries": q,
             "rows_per_batch_mean": round(q / b, 2) if b else 0.0,
         }
+        lat = telemetry.registry().hist_quantiles(self._lat_name)
+        if lat is not None:
+            out["latency"] = lat
+        return out
 
     # -- dispatcher ---------------------------------------------------------
 
@@ -1427,7 +1472,7 @@ class TopKServer:
         arr = (
             batch[0][0]
             if len(batch) == 1
-            else np.concatenate([codes for codes, _ in batch], axis=0)
+            else np.concatenate([req[0] for req in batch], axis=0)
         )
         n = arr.shape[0]
         # bucket-pad the coalesced rows so the jitted top-k compiles one
@@ -1449,7 +1494,8 @@ class TopKServer:
                 EVENTS.SERVE_TOPK_ERROR, error=repr(e), rows=int(n),
                 requests=len(batch), m=int(self.m),
             )
-            for _, fut in batch:
+            for req in batch:
+                fut = req[1]
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(e)
             return
@@ -1468,11 +1514,32 @@ class TopKServer:
                 wall_s=round(wall, 6),
             )
         self._batch_served(index, n, pad_to, len(batch), wall)
+        reg = telemetry.registry()
+        tel = telemetry.enabled()
         lo = 0
-        for codes, fut in batch:
+        for codes, fut, label, t_enq in batch:
             hi = lo + codes.shape[0]
             if fut.set_running_or_notify_cancel():
                 fut.set_result((d[lo:hi], i[lo:hi]))
+            # per-request tail-latency stamps (r17): enqueue (submit),
+            # dispatch (t0, just before the coalesced query_topk) and
+            # completion (now, after the future resolved) — all
+            # perf_counter, so the differences are monotone
+            t_comp = _time.perf_counter()
+            total = t_comp - t_enq
+            queue_wait = t0 - t_enq
+            reg.observe(self._lat_name, total)
+            reg.observe(self._lat_name + ".queue_wait", queue_wait)
+            reg.observe(self._lat_name + ".serve", wall)
+            if label is not None:
+                reg.observe(f"{self._lat_name}.client.{label}", total)
+            if tel:
+                telemetry.emit(
+                    EVENTS.SERVE_LATENCY_REQUEST, server=self.name,
+                    label=label, rows=int(hi - lo), m=int(self.m),
+                    queue_wait_s=round(queue_wait, 9),
+                    serve_s=round(wall, 9), total_s=round(total, 9),
+                )
             lo = hi
 
     def _run(self) -> None:

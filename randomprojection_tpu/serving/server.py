@@ -21,7 +21,10 @@ index" already is).
 Telemetry: every routed dispatch emits ``serve.shard.batch`` (replica,
 shard fanout, rows, wall) and bumps the ``serve.shard.*`` counters the
 doctor's serving section reads, alongside the base server's
-``serve.topk.*`` accounting.
+``serve.topk.*`` accounting.  Per-request tail latency (r17) rides the
+base class: requests are stamped enqueue→dispatch→complete into the
+``serve.latency.sharded`` histograms (``name=`` overrides the key) and
+per client label — see ``TopKServer``.
 """
 
 from __future__ import annotations
@@ -42,7 +45,7 @@ class ShardedTopKServer(TopKServer):
 
     def __init__(self, replicas, m: int, *, max_batch: int = 8192,
                  max_delay_s: float = 0.002, max_pending: int = 8192,
-                 start: bool = True):
+                 name: str = "sharded", start: bool = True):
         if not isinstance(replicas, (list, tuple)):
             replicas = [replicas]
         replicas = list(replicas)
@@ -74,7 +77,7 @@ class ShardedTopKServer(TopKServer):
         self._route_lock = threading.Lock()
         super().__init__(
             first, m, max_batch=max_batch, max_delay_s=max_delay_s,
-            max_pending=max_pending, start=start,
+            max_pending=max_pending, name=name, start=start,
         )
 
     @property

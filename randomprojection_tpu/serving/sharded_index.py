@@ -547,6 +547,12 @@ class ShardedSimHashIndex:
             with self._merge_stats_lock:
                 self._merges += 1
                 self._merge_wall_s += wall
+            # live plane (r17): the per-merge wall as a registry gauge
+            # (last/mean/max) so a scrape sees cross-shard merge cost
+            # without replaying the event log
+            telemetry.registry().gauge_set(
+                "serve.shard.merge_wall_s", wall
+            )
             if telemetry.enabled():
                 telemetry.emit(
                     EVENTS.SHARD_MERGE, queries=int(t), candidates=int(k),

@@ -474,6 +474,12 @@ class PrefetchSource(RowBatchSource):
                             # bound), depth = full, the producer must wait
                             # (consumer-bound)
                             self.stats.on_queue_depth(depth_now)
+                        # live plane (r17): mirror the depth onto the
+                        # PROCESS registry so a --metrics-port scrape
+                        # sees it without a StreamStats wiring
+                        telemetry.registry().gauge_set(
+                            "stream.queue.depth", depth_now
+                        )
                         telemetry.emit(
                             EVENTS.STREAM_PREFETCH_DELIVER, row=int(lo),
                             queue_depth=int(depth_now), capacity=self.depth,
@@ -755,6 +761,11 @@ class StagedIngestSource(RowBatchSource):
                     depth_now = out_q.qsize()
                     if self.stats is not None:
                         self.stats.on_queue_depth(depth_now)
+                    # live plane (r17): process-registry mirror, same as
+                    # the prefetch deliver site
+                    telemetry.registry().gauge_set(
+                        "stream.queue.depth", depth_now
+                    )
                     telemetry.emit(
                         EVENTS.STREAM_STAGED_DELIVER, row=int(lo),
                         queue_depth=int(depth_now), capacity=self.depth,

@@ -65,6 +65,29 @@ the root and passes it through the queue; the consumer re-activates it
 (``activate_span``) around its dispatch/d2h stages.
 ``utils/trace_report.py`` rebuilds per-batch timelines and critical-
 path attribution from the resulting span stream.
+
+Live subscribers (r17)
+----------------------
+
+Everything above is post-hoc: the JSONL file is read back after the
+run.  The live observability plane adds an IN-PROCESS path:
+``subscribe(fn)`` registers a subscriber that receives every emitted
+event/span as a plain dict — delivered through a bounded per-subscriber
+queue drained by that subscriber's own daemon dispatch thread, so a
+slow (or wedged) subscriber can NEVER block or slow the emitting hot
+path: when its queue is full the event is dropped for that subscriber
+only, counted in the ``telemetry.subscriber.dropped`` registry counter
+(and surfaced as a rate-limited ``telemetry.subscriber.dropped`` event
+from the dispatch thread).  Subscribers make telemetry "active" on
+their own: spans and events flow to them even when no JSONL sink is
+configured — a serving process can be observed live without writing a
+file.  ``LiveAggregator`` is the shipped subscriber: it folds the span
+stream into rolling windowed per-stage stats (the doctor's critical-
+path inputs, incremental) plus a TIME-WEIGHTED queue-depth view — the
+last delivered depth persists between deliver events, so a stalled
+stage shows its queue pinned instead of going blind (the post-hoc
+report only sees depth AT deliveries).  ``utils/metrics_server.py``
+exposes the whole picture on a scrapeable HTTP endpoint.
 """
 
 from __future__ import annotations
@@ -73,10 +96,12 @@ import contextlib
 import json
 import math
 import os
+import queue as _queue_mod
 import re
 import sys
 import threading
 import time
+from collections import deque
 from typing import Iterator, Optional
 
 __all__ = [
@@ -101,6 +126,11 @@ __all__ = [
     "current_span",
     "trace_fields",
     "to_openmetrics",
+    "quantiles_from_buckets",
+    "Subscription",
+    "subscribe",
+    "unsubscribe",
+    "LiveAggregator",
 ]
 
 SCHEMA_VERSION = 2
@@ -186,6 +216,16 @@ class EVENTS:
     RECOVER_RESUME = "recover.resume"
     RECOVER_CHECKSUM_MISMATCH = "recover.checksum_mismatch"
     RECOVER_ORPHAN_CHUNK = "recover.orphan_chunk"
+    # live observability plane (r17): subscriber overflow (emitted by the
+    # dispatch thread, rate-limited — the emitting hot path only counts),
+    # per-request serving latency (enqueue→dispatch→complete stamps from
+    # TopKServer/ShardedTopKServer), and the open-loop load generator's
+    # run summary.  Deliberately NOT families — rogue
+    # ``telemetry.subscriber.*`` / ``serve.latency.*`` / ``loadgen.*``
+    # names stay lintable (rp02_live_bad.py).
+    TELEMETRY_SUBSCRIBER_DROPPED = "telemetry.subscriber.dropped"
+    SERVE_LATENCY_REQUEST = "serve.latency.request"
+    LOADGEN_RUN = "loadgen.run"
 
     # runtime-completed name families.  ``*_FAMILY`` constants are the
     # prefixes callers build on (today: the per-kernel-path hash counter
@@ -326,6 +366,20 @@ class MetricsRegistry:
                 if k.startswith(prefix)
             }
 
+    def hist_quantiles(self, name: str,
+                       qs=(0.5, 0.9, 0.99, 0.999)) -> Optional[dict]:
+        """HDR-style quantile extraction from a log2-bucket histogram:
+        ``{"p50": seconds, "p90": ..., "count": exact, "sum": exact}``
+        (see ``quantiles_from_buckets`` for the estimation contract), or
+        None when the histogram was never observed."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                return None
+            buckets = dict(h["buckets"])
+            count, total = h["count"], h["sum"]
+        return quantiles_from_buckets(buckets, count, total, qs)
+
     # -- snapshot -----------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -346,6 +400,69 @@ class MetricsRegistry:
                     for k, h in self._hists.items()
                 },
             }
+
+
+def quantiles_from_buckets(buckets: dict, count: int, total: float,
+                           qs=(0.5, 0.9, 0.99, 0.999)) -> dict:
+    """Quantile extraction from a fixed-log2-bucket histogram snapshot
+    (bucket ``i`` holds samples in ``[2^i, 2^(i+1))`` µs; ``count`` and
+    ``total`` are the registry's EXACT tallies, never approximated).
+
+    Returns ``{"p50": seconds, ..., "count": count, "sum": total,
+    "mean": total/count}`` with one ``p<q*100>`` key per requested
+    quantile.  Estimation contract:
+
+    - ``count == 0`` → every quantile is None (an empty histogram has no
+      quantiles; callers render "-", never 0.0 — a fake zero would read
+      as a sub-microsecond latency).
+    - ``count == 1`` → every quantile is EXACTLY ``total`` (the single
+      sample's value is recoverable from the exact sum).
+    - otherwise quantile rank ``q*(count-1)`` lands in a bucket by
+      cumulative count and interpolates linearly inside it, clamped to
+      the bucket edges — the estimate is within one bucket of the true
+      value, i.e. a factor-of-2 relative error bound (bucket 0's lower
+      edge is taken as 0 s: it also holds every sub-microsecond sample).
+
+    Quantiles are monotone in ``q`` by construction (the cumulative walk
+    never moves backwards), including under concurrent recording — the
+    snapshot is taken under the registry lock.
+    """
+    out = {"count": int(count), "sum": total,
+           "mean": (total / count) if count else None}
+    if count <= 0:
+        for q in qs:
+            out[_q_key(q)] = None
+        return out
+    if count == 1:
+        for q in qs:
+            out[_q_key(q)] = total
+        return out
+    items = sorted((int(b), c) for b, c in buckets.items())
+    for q in qs:
+        rank = q * (count - 1)  # 0-based fractional rank
+        cum = 0
+        val = None
+        for b, c in items:
+            if cum + c > rank:
+                lo = 0.0 if b == 0 else (1 << b) * 1e-6
+                hi = (1 << (b + 1)) * 1e-6
+                # linear interpolation by the rank's position within
+                # this bucket's occupants
+                frac = (rank - cum) / c if c > 1 else 0.5
+                val = lo + frac * (hi - lo)
+                break
+            cum += c
+        if val is None:  # rank beyond the last bucket (shouldn't happen)
+            b = items[-1][0]  # pragma: no cover — defensive
+            val = (1 << (b + 1)) * 1e-6  # pragma: no cover
+        out[_q_key(q)] = val
+    return out
+
+
+def _q_key(q: float) -> str:
+    """0.5 → "p50", 0.999 → "p99.9" (trailing zeros dropped)."""
+    s = f"{q * 100:.4f}".rstrip("0").rstrip(".")
+    return f"p{s}"
 
 
 _DEFAULT_REGISTRY = MetricsRegistry()
@@ -439,6 +556,12 @@ class TelemetryLog:
     def emit(self, event: str, **fields) -> None:
         rec = {"v": SCHEMA_VERSION, "ts": time.time(), "event": event}
         rec.update(fields)
+        self.emit_record(rec)
+
+    def emit_record(self, rec: dict) -> None:
+        """Write one already-assembled event dict (the module ``emit()``
+        builds the record once and hands it to the sink AND the live
+        subscribers)."""
         line = json.dumps(rec, separators=(",", ":"))
         with self._lock:
             if self._f is None:  # pragma: no cover - emit after close
@@ -482,9 +605,11 @@ def shutdown() -> None:
 
 
 def enabled() -> bool:
-    """True when a process-wide sink is installed.  Hot paths with
-    non-trivial payload construction should guard on this."""
-    return _ACTIVE_LOG is not None
+    """True when a process-wide sink is installed OR at least one live
+    subscriber is registered (the live plane makes telemetry active
+    without any JSONL file).  Hot paths with non-trivial payload
+    construction should guard on this."""
+    return _ACTIVE_LOG is not None or bool(_SUBSCRIPTIONS)
 
 
 def active_path() -> Optional[str]:
@@ -507,19 +632,353 @@ def _finalizing() -> bool:
 
 
 def emit(event: str, **fields) -> None:
-    """Emit one event to the process-wide sink; no-op when none is
-    installed (one global read — safe in hot paths).  Safe during
+    """Emit one event to the process-wide sink AND every live
+    subscriber; no-op when neither is installed (two global reads —
+    safe in hot paths).  Subscriber delivery is a non-blocking bounded
+    enqueue: a full subscriber queue drops the event for that
+    subscriber (counted), never stalls the emitter.  Safe during
     interpreter teardown: a late emit from a daemon thread or a
     ``__del__`` is dropped instead of raising into the finalizer."""
     log = _ACTIVE_LOG
-    if log is None:
+    subs = _SUBSCRIPTIONS
+    if log is None and not subs:
         return
     try:
-        log.emit(event, **fields)
+        rec = {"v": SCHEMA_VERSION, "ts": time.time(), "event": event}
+        rec.update(fields)
+        if log is not None:
+            log.emit_record(rec)
+        for s in subs:
+            s._offer(rec)
     except Exception:
         if _finalizing():
             return
         raise
+
+
+# -- live subscribers (r17) ---------------------------------------------------
+
+
+class Subscription:
+    """One live event subscriber: a bounded queue fed by ``emit()`` and
+    drained by this subscription's own daemon dispatch thread, which
+    calls ``fn(event_dict)`` for every delivered event.
+
+    Delivery contract:
+
+    - the emitting thread only ever does a non-blocking enqueue; when
+      the queue is full the event is DROPPED for this subscriber
+      (``telemetry.subscriber.dropped`` counter on the default
+      registry + the per-subscription ``stats()`` tally) — overload
+      degrades the observer, never the observed;
+    - events arrive on the dispatch thread in emit order (per-queue
+      FIFO); a raising ``fn`` is counted (``errors``) and delivery
+      continues — one bad callback must not kill the plane;
+    - the dispatch thread reports accumulated drops as a rate-limited
+      ``telemetry.subscriber.dropped`` EVENT (at most one per
+      ``_DROP_REPORT_S``) so overload is visible on the spine, not just
+      in a counter nobody scrapes.
+
+    Create with ``subscribe()``; detach with ``unsubscribe()`` /
+    ``close()`` (drains nothing further, joins the dispatch thread).
+    """
+
+    _POLL_S = 0.05
+    _DROP_REPORT_S = 1.0
+
+    def __init__(self, fn, *, maxsize: int = 1024, name: str = ""):
+        if not callable(fn):
+            raise TypeError(f"subscriber fn must be callable, got {fn!r}")
+        if maxsize < 1:
+            raise ValueError(f"maxsize must be >= 1, got {maxsize}")
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", "subscriber")
+        self._q: "_queue_mod.Queue" = _queue_mod.Queue(maxsize=maxsize)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._dropped = 0
+        self._delivered = 0
+        self._errors = 0
+        self._last_drop_report = 0.0
+        self._reported_drops = 0
+        self._thread = threading.Thread(
+            target=self._run, name=f"rp-telemetry-sub-{self.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # emitter side — called from emit() on ANY thread; must never block
+    def _offer(self, rec: dict) -> None:
+        try:
+            self._q.put_nowait(rec)
+        except _queue_mod.Full:
+            with self._lock:
+                self._dropped += 1
+            _DEFAULT_REGISTRY.counter_inc("telemetry.subscriber.dropped")
+
+    # dispatch side — this subscription's own daemon thread
+    def _run(self) -> None:
+        while True:
+            # stop is checked every iteration, not only on an empty
+            # queue: close() discards pending events (as documented)
+            # instead of delivering a full queue's worth to a possibly
+            # slow fn — close() on a wedged subscriber must not block
+            # for queue-length × callback-wall
+            if self._stop.is_set():
+                return
+            try:
+                rec = self._q.get(timeout=self._POLL_S)
+            except _queue_mod.Empty:
+                continue
+            try:
+                self.fn(rec)
+            except Exception:
+                # a raising subscriber must not kill delivery; count it
+                # so a silently-broken observer is still diagnosable
+                with self._lock:
+                    self._errors += 1
+                _DEFAULT_REGISTRY.counter_inc("telemetry.subscriber.errors")
+            with self._lock:
+                self._delivered += 1
+                drops = self._dropped - self._reported_drops
+                now = time.monotonic()
+                report = (
+                    drops > 0
+                    and now - self._last_drop_report >= self._DROP_REPORT_S
+                )
+                if report:
+                    self._reported_drops = self._dropped
+                    self._last_drop_report = now
+                    total = self._dropped
+            if report:
+                # re-enters emit() from the dispatch thread (rate-
+                # limited above); recursion is bounded: this event fans
+                # out like any other and may itself be dropped
+                emit(
+                    EVENTS.TELEMETRY_SUBSCRIBER_DROPPED,
+                    subscriber=self.name, dropped=int(drops),
+                    dropped_total=int(total),
+                )
+
+    def stats(self) -> dict:
+        """``{delivered, dropped, errors, queued}`` (thread-safe)."""
+        with self._lock:
+            return {
+                "delivered": self._delivered,
+                "dropped": self._dropped,
+                "errors": self._errors,
+                "queued": self._q.qsize(),
+            }
+
+    def close(self) -> None:
+        """Detach from the live stream (equivalent to ``unsubscribe``),
+        stop the dispatch thread (pending queued events are discarded)
+        and join it.  Idempotent.  Detaching matters: a closed-but-
+        registered subscription would keep ``enabled()`` True and its
+        full queue would count a drop on every future emit forever."""
+        with _SUB_LOCK:
+            try:
+                _SUBSCRIPTIONS.remove(self)
+            except ValueError:
+                pass
+        self._stop.set()
+        self._thread.join()
+
+    def __enter__(self) -> "Subscription":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        unsubscribe(self)
+
+
+# registered subscriptions: a plain list MUTATED under _SUB_LOCK (never
+# rebound — the hot-path readers in emit()/enabled() iterate/test it
+# lock-free, which is safe under the GIL for append/remove)
+_SUBSCRIPTIONS: list = []
+_SUB_LOCK = threading.Lock()
+
+
+def subscribe(fn, *, maxsize: int = 1024, name: str = "") -> Subscription:
+    """Register a live subscriber: ``fn(event_dict)`` will be called on
+    a dedicated daemon dispatch thread for every event/span emitted
+    from now on (bounded queue — see ``Subscription``).  Makes
+    telemetry active even without a JSONL sink.  Returns the
+    ``Subscription``; pass it to ``unsubscribe`` to detach."""
+    sub = Subscription(fn, maxsize=maxsize, name=name)
+    with _SUB_LOCK:
+        _SUBSCRIPTIONS.append(sub)
+    return sub
+
+
+def unsubscribe(sub: Subscription) -> None:
+    """Detach a subscription registered by ``subscribe`` and stop its
+    dispatch thread (alias of ``Subscription.close``).  Unknown or
+    already-removed subscriptions are a no-op (idempotent)."""
+    sub.close()
+
+
+class LiveAggregator:
+    """The shipped live subscriber: folds the event/span stream into
+    rolling-window aggregates — the doctor's per-stage critical-path
+    inputs, computed incrementally while the run is still going.
+
+    Usage: ``agg = LiveAggregator(); sub = subscribe(agg)`` (the
+    instance is itself the subscriber callable).  All state is guarded
+    by one lock; ``snapshot()`` / ``registry_snapshot()`` may be called
+    from any thread (the metrics endpoint scrapes them).
+
+    Windows (default 10 s, sliding):
+
+    - **per-stage span wall** — every ``span_end`` lands in its name's
+      window: count, summed wall, mean.
+    - **event rates** — per-event-name occurrence count in the window.
+    - **queue depth, TIME-WEIGHTED** — the satellite fix: the post-hoc
+      report samples queue depth only AT ``stream.*.deliver`` events,
+      so a stalled stage (no deliveries) is a blind spot exactly when
+      depth matters most.  Here the last delivered depth PERSISTS: the
+      window mean integrates the piecewise-constant depth signal up to
+      ``now``, and ``age_s`` says how stale the last sample is — a
+      consumer that stopped draining shows a pinned-full queue getting
+      older, not silence.
+    """
+
+    def __init__(self, window_s: float = 10.0):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._spans: dict = {}   # stage name -> deque[(ts, dur_s)]
+        self._events: dict = {}  # event name -> deque[ts]
+        self._queue: deque = deque()  # (ts, depth) samples, window+1 kept
+        self._queue_capacity: Optional[int] = None
+        self._n_seen = 0
+
+    # the subscriber callable face
+    def __call__(self, rec: dict) -> None:
+        name = rec.get("event")
+        ts = rec.get("ts")
+        if not isinstance(name, str) or not isinstance(ts, (int, float)):
+            return
+        with self._lock:
+            self._n_seen += 1
+            dq = self._events.setdefault(name, deque())
+            dq.append(ts)
+            if name == EVENTS.SPAN_END and isinstance(
+                rec.get("dur_s"), (int, float)
+            ):
+                sdq = self._spans.setdefault(
+                    str(rec.get("name")), deque()
+                )
+                sdq.append((ts, rec["dur_s"]))
+            elif name in (
+                EVENTS.STREAM_PREFETCH_DELIVER,
+                EVENTS.STREAM_STAGED_DELIVER,
+            ):
+                self._queue.append((ts, rec.get("queue_depth", 0) or 0))
+                if rec.get("capacity") is not None:
+                    self._queue_capacity = rec["capacity"]
+            self._prune(ts)
+
+    def _prune(self, now: float) -> None:
+        # under self._lock.  The queue deque keeps ONE sample older than
+        # the window: it carries the depth the window opened at (the
+        # piecewise-constant signal needs a left endpoint).
+        horizon = now - self.window_s
+        for dq in self._spans.values():
+            while dq and dq[0][0] < horizon:
+                dq.popleft()
+        for dq in self._events.values():
+            while dq and dq[0] < horizon:
+                dq.popleft()
+        while len(self._queue) > 1 and self._queue[1][0] <= horizon:
+            self._queue.popleft()
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """Rolling-window view as plain JSON: per-stage span stats,
+        per-event rates, and the time-weighted queue-depth signal
+        evaluated at ``now`` (default: wall clock — pass an explicit
+        ``now`` for deterministic tests)."""
+        if now is None:
+            now = time.time()
+        with self._lock:
+            self._prune(now)
+            horizon = now - self.window_s
+            stages = {}
+            for sname, dq in sorted(self._spans.items()):
+                if not dq:
+                    continue
+                walls = [d for _, d in dq]
+                stages[sname] = {
+                    "count": len(walls),
+                    "wall_s": round(sum(walls), 6),
+                    "mean_s": round(sum(walls) / len(walls), 6),
+                }
+            rates = {
+                ename: round(len(dq) / self.window_s, 3)
+                for ename, dq in sorted(self._events.items())
+                if dq
+            }
+            qinfo = None
+            if self._queue:
+                samples = list(self._queue)
+                last_ts, last_depth = samples[-1]
+                # integrate the piecewise-constant depth over
+                # [horizon, now]: each sample holds until the next one,
+                # the last holds until NOW — the stalled-consumer fix
+                area = 0.0
+                for (t0, d0), (t1, _) in zip(samples, samples[1:]):
+                    lo, hi = max(t0, horizon), min(t1, now)
+                    if hi > lo:
+                        area += d0 * (hi - lo)
+                lo = max(last_ts, horizon)
+                if now > lo:
+                    area += last_depth * (now - lo)
+                span_len = min(self.window_s, max(now - samples[0][0], 0.0))
+                qinfo = {
+                    "last": last_depth,
+                    "age_s": round(max(now - last_ts, 0.0), 3),
+                    "time_weighted_mean": round(
+                        area / span_len if span_len > 0 else float(last_depth),
+                        3,
+                    ),
+                    "capacity": self._queue_capacity,
+                }
+            return {
+                "window_s": self.window_s,
+                "events_seen": self._n_seen,
+                "stages": stages,
+                "event_rates": rates,
+                "queue": qinfo,
+            }
+
+    def registry_snapshot(self, now: Optional[float] = None) -> dict:
+        """The rolling window rendered as a ``MetricsRegistry.snapshot``
+        -shaped dict (gauges only) so ``to_openmetrics`` can merge it
+        into a scrape: ``live.span.<stage>.wall_s`` /
+        ``live.span.<stage>.mean_s`` / ``live.span.<stage>.count``,
+        ``live.event.<name>.rate``, and the ``live.queue.*`` depth
+        signal."""
+        snap = self.snapshot(now)
+        gauges: dict = {}
+
+        def g(gname, value):
+            gauges[gname] = {"last": value, "max": value,
+                             "sum": value, "n": 1}
+
+        for sname, st in snap["stages"].items():
+            g(f"live.span.{sname}.wall_s", st["wall_s"])
+            g(f"live.span.{sname}.mean_s", st["mean_s"])
+            g(f"live.span.{sname}.count", st["count"])
+        for ename, rate in snap["event_rates"].items():
+            g(f"live.event.{ename}.rate", rate)
+        q = snap["queue"]
+        if q is not None:
+            g("live.queue.depth", q["last"])
+            g("live.queue.depth_age_s", q["age_s"])
+            g("live.queue.depth_mean", q["time_weighted_mean"])
+            if q.get("capacity") is not None:
+                g("live.queue.capacity", q["capacity"])
+        return {"counters": {}, "gauges": gauges, "histograms": {}}
 
 
 # -- tracing spans (schema v2) ------------------------------------------------
@@ -582,7 +1041,7 @@ def start_span(name: str, *, parent: Optional[Span] = None,
                new_trace: bool = False, require_parent: bool = False,
                **attrs) -> Optional[Span]:
     """Open a span and emit its ``span_start``; returns None (a no-op
-    handle) when no sink is installed.
+    handle) when neither a sink nor a live subscriber is installed.
 
     Parenting: explicit ``parent=`` wins; otherwise the thread's active
     span; ``new_trace=True`` forces a fresh trace root (``parent_id``
@@ -590,7 +1049,7 @@ def start_span(name: str, *, parent: Optional[Span] = None,
     is no parent in scope — used by instrumented stages that only make
     sense inside a batch trace.  Close with ``end_span`` (any thread).
     """
-    if _ACTIVE_LOG is None:
+    if not enabled():
         return None
     try:
         if parent is None and not new_trace:
@@ -770,7 +1229,12 @@ def to_openmetrics(*snapshots: dict) -> str:
     wall-clock histograms → a ``<name>_seconds`` histogram whose
     ``le`` boundaries are the registry's fixed log2 bucket upper edges
     (bucket *i* = ``[2^i, 2^(i+1))`` µs ⇒ ``le = 2^(i+1)·1e-6`` s),
-    cumulative per the spec, with exact ``_sum``/``_count``.  Output is
+    cumulative per the spec, with exact ``_sum``/``_count`` — PLUS a
+    sibling ``<name>_seconds_quantile`` summary carrying
+    p50/p90/p99/p99.9 extracted from the buckets
+    (``quantiles_from_buckets``: exact for 0/1 samples, within one log2
+    bucket otherwise), the serve-latency tail numbers a scrape needs
+    without re-deriving them from bucket math.  Output is
     deterministically ordered and ends with ``# EOF``.
     """
     m = _merge_snapshots(snapshots)
@@ -799,5 +1263,16 @@ def to_openmetrics(*snapshots: dict) -> str:
         lines.append(f'{om}_bucket{{le="+Inf"}} {h["count"]}')
         lines.append(f"{om}_sum {_om_num(h['sum'])}")
         lines.append(f"{om}_count {h['count']}")
+        qs = quantiles_from_buckets(h["buckets"], h["count"], h["sum"])
+        if qs["count"]:
+            qom = om + "_quantile"
+            lines.append(f"# TYPE {qom} summary")
+            for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"),
+                           (0.999, "p99.9")):
+                lines.append(
+                    f'{qom}{{quantile="{q}"}} {_om_num(qs[key])}'
+                )
+            lines.append(f"{qom}_sum {_om_num(h['sum'])}")
+            lines.append(f"{qom}_count {h['count']}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"

@@ -40,6 +40,8 @@ from typing import Optional
 
 from randomprojection_tpu.utils.telemetry import (
     EVENTS,
+    MetricsRegistry,
+    quantiles_from_buckets,
     read_events,
     registered_event,
 )
@@ -64,6 +66,9 @@ DEGRADED_EVENTS = (
     EVENTS.STREAM_STAGED_SHUTDOWN_TIMEOUT,
     EVENTS.SERVE_TOPK_ERROR,
     EVENTS.RECOVER_CHECKSUM_MISMATCH,
+    # live plane (r17): a subscriber overflowing its bounded queue means
+    # the live view lost events — degraded observability, on the audit
+    EVENTS.TELEMETRY_SUBSCRIBER_DROPPED,
 )
 
 
@@ -156,6 +161,20 @@ def build_report(path: str) -> dict:
     shard_batches = 0
     shard_batch_rows = 0
     shard_replicas: set = set()
+    # per-request serving latency (r17): folded into the same fixed
+    # log2 buckets the registry histograms use, keyed "<server>" and
+    # "<server>[label]" — O(1) memory however long the run, quantiles
+    # extracted at the end by the shared bucket math
+    lat_hists: dict = {}
+    loadgen_runs: list = []
+
+    def _lat_observe(key: str, seconds: float) -> None:
+        h = lat_hists.setdefault(key, {"sum": 0.0, "count": 0,
+                                       "buckets": {}})
+        h["sum"] += seconds
+        h["count"] += 1
+        b = MetricsRegistry._bucket(seconds)
+        h["buckets"][b] = h["buckets"].get(b, 0) + 1
 
     for e in read_events(path):
         n_events += 1
@@ -261,6 +280,24 @@ def build_report(path: str) -> dict:
             shard_batch_rows += e.get("rows", 0) or 0
             if e.get("replica") is not None:
                 shard_replicas.add(e["replica"])
+        elif name == EVENTS.SERVE_LATENCY_REQUEST:
+            # per-request enqueue→complete stamps from the serving tier
+            total = e.get("total_s")
+            if isinstance(total, (int, float)):
+                server = str(e.get("server") or "topk")
+                _lat_observe(server, total)
+                if e.get("label") is not None:
+                    _lat_observe(f"{server}[{e['label']}]", total)
+        elif name == EVENTS.LOADGEN_RUN:
+            loadgen_runs.append({
+                "requests": e.get("requests"),
+                "rows": e.get("rows"),
+                "rejects": e.get("rejects"),
+                "errors": e.get("errors"),
+                "elapsed_s": e.get("elapsed_s"),
+                "max_lag_s": e.get("max_lag_s"),
+                "schedule_sha256": e.get("schedule_sha256"),
+            })
 
     # traces whose root never ended: their buffered children are orphaned
     # work of a crashed run — count the traces as incomplete
@@ -374,6 +411,18 @@ def build_report(path: str) -> dict:
             if (topk_dispatches or shard_tiles or shard_batches)
             else None
         ),
+        "latency": (
+            {
+                key: quantiles_from_buckets(
+                    {str(b): c for b, c in h["buckets"].items()},
+                    h["count"], h["sum"],
+                )
+                for key, h in sorted(lat_hists.items())
+            }
+            if lat_hists
+            else None
+        ),
+        "loadgen": loadgen_runs or None,
         "degraded": degraded,
         "unregistered_events": unregistered,
         "recovery": (
@@ -478,6 +527,33 @@ def render_report(report: dict) -> str:
                 f"  replica routing: {sv['shard_batches']} coalesced "
                 f"batch(es), {sv['shard_batch_rows']} rows over "
                 f"{len(reps)} replica(s)"
+            )
+    lat = report.get("latency")
+    if lat:
+        lines.append("")
+        lines.append(
+            "serve latency (enqueue→complete, per server / [label]; "
+            "bucket-estimated quantiles, exact count/mean):"
+        )
+        for key, q in lat.items():
+            qtxt = "  ".join(
+                f"{p}={q[p] * 1e3:.2f}ms" if q[p] is not None else f"{p}=-"
+                for p in ("p50", "p90", "p99", "p99.9")
+            )
+            lines.append(
+                f"  {key:<24} n={q['count']:<7} "
+                f"mean={q['mean'] * 1e3:.2f}ms  {qtxt}"
+            )
+    lg = report.get("loadgen")
+    if lg:
+        lines.append("")
+        lines.append("loadgen (open-loop) runs:")
+        for r in lg:
+            lines.append(
+                f"  {r['requests']} requests / {r['rows']} rows in "
+                f"{r['elapsed_s']}s — rejects {r['rejects']}, errors "
+                f"{r['errors']}, max submit lag {r['max_lag_s']}s, "
+                f"schedule {str(r['schedule_sha256'])[:12]}"
             )
     lines.append("")
     lines.append("degraded-event audit:")

@@ -1,0 +1,31 @@
+"""RP02 fixture (ISSUE r17 satellite): live-plane emitters using
+``telemetry.subscriber.*`` / ``serve.latency.*`` / ``loadgen.*`` event
+names that are NOT in ``telemetry.EVENTS``.  Linted against the REAL
+registry — the live-plane namespaces deliberately have NO family
+prefix, so every subscriber/latency/loadgen event must be individually
+registered (a family would wave rogue names past the doctor's latency
+section and the degraded audit)."""
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+
+def overflowing_subscriber(dropped):
+    # VIOLATION: a subscriber-plane event dodging the registry —
+    # invisible to the degraded-event audit
+    telemetry.emit("telemetry.subscriber.rogue_overflow", dropped=dropped)
+    # ok: the registered overload event
+    telemetry.emit(EVENTS.TELEMETRY_SUBSCRIBER_DROPPED, dropped=dropped)
+
+
+def serving_latency(total_s):
+    # VIOLATION: a latency event the doctor's latency section never reads
+    telemetry.emit("serve.latency.rogue_window", total_s=total_s)
+    # ok: the registered per-request latency record
+    telemetry.emit(EVENTS.SERVE_LATENCY_REQUEST, total_s=total_s)
+
+
+def loadgen_summary(requests):
+    # VIOLATION: a loadgen event outside the registry
+    telemetry.emit("loadgen.rogue_tick", requests=requests)
+    # ok: the registered run summary
+    telemetry.emit(EVENTS.LOADGEN_RUN, requests=requests)

@@ -1,0 +1,720 @@
+"""Live observability plane (ISSUE r17): in-process subscribers
+(bounded queues, drop-never-block, no-sink activation), LiveAggregator
+rolling windows incl. the time-weighted queue-depth fix for stalled
+consumers, histogram quantile extraction (exact edge cases, concurrent
+monotonicity, OpenMetrics round trip through a real HTTP scrape), the
+metrics endpoint, per-request serve latency stamps in
+TopKServer/ShardedTopKServer, the doctor's latency/loadgen sections and
+--live mode, the deterministic open-loop load generator, and the
+live-smoke harness."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu import cli, loadgen
+from randomprojection_tpu.models.sketch import SimHashIndex, TopKServer
+from randomprojection_tpu.utils import metrics_server, telemetry
+from randomprojection_tpu.utils.telemetry import (
+    EVENTS,
+    LiveAggregator,
+    MetricsRegistry,
+    quantiles_from_buckets,
+)
+
+
+def _drain(sub, predicate, timeout=5.0):
+    """Wait until the subscriber-side predicate holds (dispatch is
+    async)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -- subscribers -------------------------------------------------------------
+
+
+def test_subscriber_receives_events_without_a_sink():
+    """subscribe() alone activates telemetry: events AND spans flow to
+    the subscriber with no JSONL file configured."""
+    assert not telemetry.enabled()
+    got = []
+    sub = telemetry.subscribe(got.append, name="t-basic")
+    try:
+        assert telemetry.enabled()
+        telemetry.emit(EVENTS.STREAM_COMMIT, row=7)
+        with telemetry.span("batch", new_trace=True):
+            pass
+        assert _drain(sub, lambda: len(got) >= 3)
+        names = [r["event"] for r in got]
+        assert EVENTS.STREAM_COMMIT in names
+        assert EVENTS.SPAN_START in names and EVENTS.SPAN_END in names
+        commit = next(r for r in got if r["event"] == EVENTS.STREAM_COMMIT)
+        assert commit["row"] == 7 and commit["v"] == telemetry.SCHEMA_VERSION
+    finally:
+        telemetry.unsubscribe(sub)
+    assert not telemetry.enabled()
+    # after unsubscribe nothing is delivered and emit is a no-op again
+    telemetry.emit(EVENTS.STREAM_COMMIT, row=8)
+    assert not any(r.get("row") == 8 for r in got)
+
+
+def test_slow_subscriber_drops_but_never_blocks_the_emitter():
+    """THE acceptance property: a deliberately slow subscriber with a
+    tiny queue loses events (counter-visible) while the emitting thread
+    stays fast — emit() must never wait on the subscriber."""
+    reg = telemetry.registry()
+    dropped_before = reg.counter("telemetry.subscriber.dropped")
+
+    def slow(rec):
+        time.sleep(0.05)
+
+    sub = telemetry.subscribe(slow, maxsize=4, name="t-slow")
+    try:
+        n = 500
+        t0 = time.perf_counter()
+        for i in range(n):
+            telemetry.emit(EVENTS.STREAM_COMMIT, row=i)
+        emit_wall = time.perf_counter() - t0
+        # 500 emits against a subscriber that needs 25 s to drain them:
+        # if the emitter ever blocked on the queue this takes seconds.
+        # Generous bound for slow CI boxes — blocking would be ~25 s.
+        assert emit_wall < 2.0, f"emit path blocked: {emit_wall:.3f}s"
+        assert _drain(sub, lambda: sub.stats()["dropped"] > 0)
+        st = sub.stats()
+        assert st["dropped"] >= n - 4 - st["delivered"] - st["queued"] - 1
+        assert (
+            reg.counter("telemetry.subscriber.dropped") - dropped_before
+            >= st["dropped"] > 0
+        )
+    finally:
+        telemetry.unsubscribe(sub)
+
+
+def test_subscriber_overflow_reported_as_event(tmp_path):
+    """The dispatch thread surfaces accumulated drops as a rate-limited
+    telemetry.subscriber.dropped EVENT on the spine (degraded audit)."""
+    path = str(tmp_path / "ev.jsonl")
+    telemetry.configure(path)
+    sub = telemetry.subscribe(
+        lambda rec: time.sleep(0.02), maxsize=2, name="t-overflow"
+    )
+    try:
+        for i in range(100):
+            telemetry.emit(EVENTS.STREAM_COMMIT, row=i)
+        assert _drain(
+            sub, lambda: sub.stats()["dropped"] > 0 and
+            sub.stats()["delivered"] > 0, timeout=10.0,
+        )
+        time.sleep(0.3)  # let the dispatch thread file its report
+    finally:
+        telemetry.unsubscribe(sub)
+        telemetry.shutdown()
+    evs = [
+        e for e in telemetry.read_events(path)
+        if e["event"] == EVENTS.TELEMETRY_SUBSCRIBER_DROPPED
+    ]
+    assert evs, "no overflow event reached the sink"
+    assert evs[0]["subscriber"] == "t-overflow"
+    assert evs[0]["dropped"] > 0 and evs[0]["dropped_total"] > 0
+
+
+def test_raising_subscriber_is_counted_and_delivery_continues():
+    calls = []
+
+    def bad(rec):
+        calls.append(rec)
+        raise RuntimeError("observer broke")
+
+    sub = telemetry.subscribe(bad, name="t-raise")
+    try:
+        telemetry.emit(EVENTS.STREAM_COMMIT, row=1)
+        telemetry.emit(EVENTS.STREAM_COMMIT, row=2)
+        assert _drain(sub, lambda: sub.stats()["delivered"] >= 2)
+        assert sub.stats()["errors"] >= 2
+        assert len(calls) == 2  # second event still delivered
+    finally:
+        telemetry.unsubscribe(sub)
+
+
+def test_close_detaches_like_unsubscribe():
+    """Review regression: ``close()`` must REMOVE the subscription —
+    a closed-but-registered subscription would keep ``enabled()`` True
+    forever and count a drop on every future emit once its dead queue
+    filled."""
+    sub = telemetry.subscribe(lambda rec: None, maxsize=2, name="t-close")
+    assert telemetry.enabled()
+    sub.close()
+    assert not telemetry.enabled()
+    before = telemetry.registry().counter("telemetry.subscriber.dropped")
+    for i in range(10):
+        telemetry.emit(EVENTS.STREAM_COMMIT, row=i)
+    assert (
+        telemetry.registry().counter("telemetry.subscriber.dropped")
+        == before
+    ), "a closed subscription still received (and dropped) emits"
+
+
+def test_close_discards_pending_events_quickly():
+    """Review regression: close() on a slow subscriber with a full
+    queue must discard the backlog (documented), not deliver it — a
+    1024-deep queue at 50 ms/event would block close() for ~51 s."""
+    sub = telemetry.subscribe(
+        lambda rec: time.sleep(0.2), maxsize=64, name="t-discard"
+    )
+    for i in range(64):
+        telemetry.emit(EVENTS.STREAM_COMMIT, row=i)
+    t0 = time.perf_counter()
+    telemetry.unsubscribe(sub)
+    # worst case: one in-flight callback (0.2 s) + one poll interval
+    assert time.perf_counter() - t0 < 2.0
+    assert sub.stats()["delivered"] < 64
+
+
+def test_unsubscribe_is_idempotent_and_validates_args():
+    sub = telemetry.subscribe(lambda rec: None, name="t-idem")
+    telemetry.unsubscribe(sub)
+    telemetry.unsubscribe(sub)  # no-op, no raise
+    with pytest.raises(TypeError):
+        telemetry.subscribe("not-callable")
+    with pytest.raises(ValueError):
+        telemetry.subscribe(lambda rec: None, maxsize=0)
+
+
+# -- LiveAggregator ----------------------------------------------------------
+
+
+def test_live_aggregator_span_windows_and_pruning():
+    agg = LiveAggregator(window_s=10.0)
+    t0 = 1000.0
+    for i in range(5):
+        agg({"v": 2, "ts": t0 + i, "event": "span_end",
+             "name": "dispatch", "dur_s": 0.1})
+    s = agg.snapshot(now=t0 + 5)
+    assert s["stages"]["dispatch"]["count"] == 5
+    assert s["stages"]["dispatch"]["wall_s"] == pytest.approx(0.5)
+    # 11 s later the window has slid past every sample
+    s = agg.snapshot(now=t0 + 16)
+    assert "dispatch" not in s["stages"]
+
+
+def test_live_aggregator_queue_depth_survives_a_stalled_consumer():
+    """The satellite fix, regression-pinned: deliver events stop (the
+    consumer stalled) but the queue signal must NOT go blind — the last
+    depth persists into the window mean and ages visibly.  The post-hoc
+    report only sees depth AT deliveries; the live window sees it
+    BETWEEN them."""
+    agg = LiveAggregator(window_s=10.0)
+    t0 = 2000.0
+    agg({"v": 2, "ts": t0, "event": EVENTS.STREAM_PREFETCH_DELIVER,
+         "queue_depth": 0, "capacity": 4})
+    agg({"v": 2, "ts": t0 + 1, "event": EVENTS.STREAM_PREFETCH_DELIVER,
+         "queue_depth": 4, "capacity": 4})
+    # ... then the consumer stalls: no deliveries for 8 seconds
+    q = agg.snapshot(now=t0 + 9)["queue"]
+    assert q["last"] == 4
+    assert q["age_s"] == pytest.approx(8.0)
+    assert q["capacity"] == 4
+    # depth 0 held 1 s, depth 4 held 8 s over a 9 s signal
+    assert q["time_weighted_mean"] == pytest.approx(32 / 9, abs=0.01)
+    # an event-count view would say "2 samples, mean 2" — the stall is
+    # precisely what it cannot see
+    # once the window slides past the old samples the pinned depth still
+    # dominates (it persists as the piecewise-constant tail)
+    q = agg.snapshot(now=t0 + 12)["queue"]
+    assert q["last"] == 4 and q["time_weighted_mean"] == pytest.approx(
+        4.0, abs=0.01
+    )
+
+
+def test_live_aggregator_registry_snapshot_renders_gauges():
+    agg = LiveAggregator(window_s=10.0)
+    now = time.time()
+    agg({"v": 2, "ts": now, "event": "span_end", "name": "h2d",
+         "dur_s": 0.25})
+    agg({"v": 2, "ts": now, "event": EVENTS.STREAM_STAGED_DELIVER,
+         "queue_depth": 3, "capacity": 8})
+    snap = agg.registry_snapshot(now=now + 1)
+    g = snap["gauges"]
+    assert g["live.span.h2d.wall_s"]["last"] == pytest.approx(0.25)
+    assert g["live.queue.depth"]["last"] == 3
+    assert g["live.queue.capacity"]["last"] == 8
+    om = telemetry.to_openmetrics(snap)
+    assert "rp_live_span_h2d_wall_s" in om and om.endswith("# EOF\n")
+
+
+# -- histogram quantiles -----------------------------------------------------
+
+
+def test_quantiles_empty_single_and_one_bucket():
+    reg = MetricsRegistry()
+    assert reg.hist_quantiles("never") is None
+    q = quantiles_from_buckets({}, 0, 0.0)
+    assert q["count"] == 0 and q["p50"] is None and q["mean"] is None
+    # single sample: EXACT via the sum, whatever its bucket says
+    reg.observe("one", 0.0123)
+    q = reg.hist_quantiles("one")
+    assert q["count"] == 1
+    for k in ("p50", "p90", "p99", "p99.9"):
+        assert q[k] == pytest.approx(0.0123)
+    # all samples in one bucket: every quantile stays inside its edges
+    reg2 = MetricsRegistry()
+    for _ in range(100):
+        reg2.observe("bkt", 0.003)  # bucket [2048, 4096) µs
+    q = reg2.hist_quantiles("bkt")
+    for k in ("p50", "p90", "p99", "p99.9"):
+        assert 0.002048 <= q[k] <= 0.004096
+    assert q["sum"] == pytest.approx(0.3) and q["count"] == 100
+
+
+def test_quantiles_factor_of_two_bound_and_monotone():
+    reg = MetricsRegistry()
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(1e-4, 1e-1, size=2000)
+    for v in vals:
+        reg.observe("h", float(v))
+    q = reg.hist_quantiles("h")
+    assert q["count"] == 2000
+    assert q["sum"] == pytest.approx(vals.sum(), rel=1e-9)
+    prev = 0.0
+    for k, pct in (("p50", 50), ("p90", 90), ("p99", 99),
+                   ("p99.9", 99.9)):
+        true = np.percentile(vals, pct)
+        assert q[k] >= prev, "quantiles must be monotone"
+        assert true / 2 <= q[k] <= true * 2, (k, q[k], true)
+        prev = q[k]
+
+
+def test_quantiles_monotone_under_concurrent_recording():
+    """4 threads hammer one histogram; the final count is exact and the
+    extracted quantiles are monotone (snapshot under the registry
+    lock)."""
+    reg = MetricsRegistry()
+    n_per = 500
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        for v in rng.uniform(1e-5, 1e-1, size=n_per):
+            reg.observe("conc", float(v))
+
+    threads = [
+        threading.Thread(target=worker, args=(s,), daemon=True)
+        for s in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    q = reg.hist_quantiles("conc")
+    assert q["count"] == 4 * n_per  # no lost updates
+    assert q["p50"] <= q["p90"] <= q["p99"] <= q["p99.9"]
+
+
+def test_quantiles_round_trip_openmetrics_and_http_scrape():
+    """Histogram → to_openmetrics quantile summary → real HTTP scrape →
+    parse_openmetrics reproduces the extracted values."""
+    reg = MetricsRegistry()
+    for v in [0.001] * 90 + [0.064] * 10:
+        reg.observe("serve.latency.rt", v)
+    want = reg.hist_quantiles("serve.latency.rt")
+    om = telemetry.to_openmetrics(reg.snapshot())
+    assert '# TYPE rp_serve_latency_rt_seconds_quantile summary' in om
+    with metrics_server.MetricsServer(
+        port=0, sources=[reg.snapshot]
+    ) as ms:
+        text = metrics_server.fetch_metrics("127.0.0.1", ms.port)
+    plain, labeled = metrics_server.parse_openmetrics(text)
+    qs = labeled["rp_serve_latency_rt_seconds_quantile"]
+    assert qs['quantile="0.5"'] == pytest.approx(want["p50"])
+    assert qs['quantile="0.999"'] == pytest.approx(want["p99.9"])
+    assert plain["rp_serve_latency_rt_seconds_quantile_count"] == 100
+    # the histogram itself rode along, cumulative and EOF-terminated
+    assert "rp_serve_latency_rt_seconds_bucket" in labeled
+    assert text.endswith("# EOF\n")
+
+
+# -- metrics endpoint --------------------------------------------------------
+
+
+def test_metrics_server_serves_404_and_sources_and_close_idempotent():
+    reg = MetricsRegistry()
+    reg.counter_inc("probe.hits", 3)
+    ms = metrics_server.MetricsServer(port=0)
+    try:
+        import urllib.error
+        import urllib.request
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ms.port}/nope", timeout=5
+            )
+        assert ei.value.code == 404
+        text = metrics_server.fetch_metrics("127.0.0.1", ms.port)
+        assert "rp_probe_hits_total" not in text  # not registered yet
+        ms.add_source(reg.snapshot)
+        text = metrics_server.fetch_metrics("127.0.0.1", ms.port)
+        assert "rp_probe_hits_total 3" in text
+        ms.remove_source(reg.snapshot)
+        text = metrics_server.fetch_metrics("127.0.0.1", ms.port)
+        assert "rp_probe_hits_total" not in text
+    finally:
+        ms.close()
+        ms.close()  # idempotent
+
+
+def test_metrics_server_skips_a_raising_source():
+    def broken():
+        raise RuntimeError("torn down")
+
+    with metrics_server.MetricsServer(port=0, sources=[broken]) as ms:
+        text = metrics_server.fetch_metrics("127.0.0.1", ms.port)
+    assert text.endswith("# EOF\n")  # scrape survives the bad source
+
+
+# -- per-request serving latency ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_index():
+    rng = np.random.default_rng(0)
+    return SimHashIndex(
+        rng.integers(0, 256, size=(600, 8), dtype=np.uint8)
+    )
+
+
+def test_topk_server_latency_histograms_and_event(small_index, tmp_path):
+    path = str(tmp_path / "lat.jsonl")
+    telemetry.configure(path)
+    rng = np.random.default_rng(1)
+    try:
+        with TopKServer(
+            small_index, 5, max_delay_s=0.001, name="lat-test"
+        ) as srv:
+            futs = []
+            for i in range(12):
+                futs.append(srv.submit(
+                    rng.integers(0, 256, size=(3, 8), dtype=np.uint8),
+                    label=f"tenant-{i % 3}",
+                ))
+            for f in futs:
+                f.result()
+            st = srv.stats()
+    finally:
+        telemetry.shutdown()
+    lat = st["latency"]
+    assert lat["count"] == 12
+    assert lat["p50"] is not None and lat["p50"] <= lat["p99.9"]
+    reg = telemetry.registry()
+    for t in range(3):
+        q = reg.hist_quantiles(f"serve.latency.lat-test.client.tenant-{t}")
+        assert q is not None and q["count"] == 4
+    qw = reg.hist_quantiles("serve.latency.lat-test.queue_wait")
+    assert qw is not None and qw["count"] == 12
+    evs = [
+        e for e in telemetry.read_events(path)
+        if e["event"] == EVENTS.SERVE_LATENCY_REQUEST
+    ]
+    assert len(evs) == 12
+    for e in evs:
+        assert e["server"] == "lat-test"
+        assert e["label"].startswith("tenant-")
+        assert 0 <= e["queue_wait_s"] <= e["total_s"]
+
+
+def test_labels_are_sanitized_for_metric_names(small_index):
+    rng = np.random.default_rng(2)
+    with TopKServer(
+        small_index, 3, max_delay_s=0.0, name="lat-sane"
+    ) as srv:
+        srv.query(
+            rng.integers(0, 256, size=(2, 8), dtype=np.uint8),
+            label='evil {label="x"} \n',
+        )
+    reg = telemetry.registry()
+    hits = [
+        k for k in reg.snapshot()["histograms"]
+        if k.startswith("serve.latency.lat-sane.client.")
+    ]
+    assert len(hits) == 1
+    assert '"' not in hits[0] and "\n" not in hits[0] and "{" not in hits[0]
+
+
+def test_sharded_server_uses_its_own_latency_key(small_index):
+    from randomprojection_tpu.serving import ShardedTopKServer
+
+    rng = np.random.default_rng(3)
+    srv = ShardedTopKServer([small_index], 3, max_delay_s=0.0,
+                            name="lat-shard")
+    try:
+        srv.query(rng.integers(0, 256, size=(2, 8), dtype=np.uint8),
+                  label="a")
+        st = srv.stats()
+    finally:
+        srv.close()
+    assert st["latency"]["count"] >= 1
+    assert telemetry.registry().hist_quantiles(
+        "serve.latency.lat-shard.client.a"
+    )["count"] == 1
+
+
+def test_topk_server_rejects_bad_name(small_index):
+    with pytest.raises(ValueError):
+        TopKServer(small_index, 3, name="", start=False)
+
+
+# -- doctor: latency section + --live ----------------------------------------
+
+
+def test_trace_report_latency_and_loadgen_sections(tmp_path):
+    from randomprojection_tpu.utils.trace_report import (
+        DEGRADED_EVENTS,
+        build_report,
+        render_report,
+    )
+
+    assert EVENTS.TELEMETRY_SUBSCRIBER_DROPPED in DEGRADED_EVENTS
+    path = str(tmp_path / "doc.jsonl")
+    telemetry.configure(path)
+    try:
+        for i in range(20):
+            telemetry.emit(
+                EVENTS.SERVE_LATENCY_REQUEST, server="s1",
+                label="a" if i % 2 else "b", rows=4,
+                queue_wait_s=0.001, serve_s=0.002,
+                total_s=0.004 * (1 + i % 3),
+            )
+        telemetry.emit(
+            EVENTS.LOADGEN_RUN, requests=20, rows=80, rejects=1,
+            errors=0, elapsed_s=0.5, max_lag_s=0.0,
+            schedule_sha256="abc123",
+        )
+    finally:
+        telemetry.shutdown()
+    rep = build_report(path)
+    assert set(rep["latency"]) == {"s1", "s1[a]", "s1[b]"}
+    assert rep["latency"]["s1"]["count"] == 20
+    assert rep["latency"]["s1[a]"]["count"] == 10
+    assert rep["latency"]["s1"]["p50"] is not None
+    assert rep["loadgen"][0]["schedule_sha256"] == "abc123"
+    text = render_report(rep)
+    assert "serve latency" in text and "loadgen (open-loop)" in text
+
+
+def test_doctor_live_polls_a_real_endpoint(capsys):
+    telemetry.registry().observe("serve.latency.live-doc", 0.004)
+    agg = LiveAggregator()
+    agg({"v": 2, "ts": time.time(), "event": "span_end",
+         "name": "dispatch", "dur_s": 0.5})
+    with metrics_server.MetricsServer(port=0, aggregator=agg) as ms:
+        rv = cli.main([
+            "doctor", "--live", f"127.0.0.1:{ms.port}",
+            "--iterations", "2", "--interval", "0.05",
+        ])
+        assert rv == 0
+        out = capsys.readouterr().out
+        assert "live doctor" in out and "poll #2" in out
+        assert "dispatch" in out  # the live span window rendered
+        # JSON mode: one parseable object per poll
+        cli.main([
+            "doctor", "--live", f"127.0.0.1:{ms.port}",
+            "--iterations", "1", "--json",
+        ])
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        parsed = json.loads(line)
+        assert "metrics" in parsed and "labeled" in parsed
+
+
+def test_doctor_live_tolerates_transient_scrape_failures(
+    monkeypatch, capsys
+):
+    """Review regression: one timed-out scrape after a healthy first
+    poll must NOT kill the dashboard — only a first-poll failure or 5
+    consecutive failures abort."""
+    calls = {"n": 0}
+    real_exposition = telemetry.to_openmetrics(
+        telemetry.registry().snapshot()
+    )
+
+    def flaky(host, port, timeout=5.0):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise OSError("timed out")
+        return real_exposition
+
+    monkeypatch.setattr(metrics_server, "fetch_metrics", flaky)
+    rv = cli.main([
+        "doctor", "--live", "127.0.0.1:9", "--iterations", "3",
+        "--interval", "0.01",
+    ])
+    assert rv == 0 and calls["n"] == 3
+    err = capsys.readouterr().err
+    assert "poll #2 failed" in err
+
+
+def test_loadgen_offered_qps_excludes_drain_time(small_index):
+    """Review regression: offered_qps is computed over the SUBMIT
+    window, not completion — a slow drain must not make the record
+    claim a lighter offered load than the schedule delivered."""
+    from randomprojection_tpu.serving import ShardedTopKServer
+
+    srv = ShardedTopKServer([small_index], 4, max_delay_s=0.001,
+                            name="lg-offered")
+    try:
+        sched = loadgen.build_schedule(
+            seed=1, duration_s=0.3, rate_qps=60, request_rows=(2,),
+            labels=("a",),
+        )
+        rec = loadgen.run(srv, sched, code_bytes=8, warmup_rows=2)
+    finally:
+        srv.close()
+    assert rec["submit_elapsed_s"] <= rec["elapsed_s"]
+    assert rec["offered_qps"] == pytest.approx(
+        len(sched) / rec["submit_elapsed_s"], rel=0.05
+    )
+
+
+def test_doctor_live_refuses_bad_target_and_unreachable():
+    with pytest.raises(SystemExit):
+        cli.main(["doctor", "--live", "nonsense"])
+    with pytest.raises(SystemExit):
+        cli.main(["doctor", "--live", "127.0.0.1:1", "--iterations", "1"])
+    with pytest.raises(SystemExit):
+        cli.main(["doctor"])  # neither file nor --live
+
+
+# -- loadgen -----------------------------------------------------------------
+
+
+def test_schedule_identical_seed_identical_schedule():
+    """THE determinism acceptance pin: same seed+params ⇒ the exact same
+    arrival schedule (times, labels, sizes) and digest; different seed ⇒
+    different digest."""
+    kw = dict(duration_s=3.0, rate_qps=40, arrival="poisson",
+              request_rows=(16, 64), labels=("a", "b", "c"))
+    s1 = loadgen.build_schedule(seed=42, **kw)
+    s2 = loadgen.build_schedule(seed=42, **kw)
+    assert s1 == s2
+    assert loadgen.schedule_digest(s1) == loadgen.schedule_digest(s2)
+    s3 = loadgen.build_schedule(seed=43, **kw)
+    assert loadgen.schedule_digest(s3) != loadgen.schedule_digest(s1)
+    assert all(0 <= r.t < 3.0 for r in s1)
+    assert {r.label for r in s1} <= {"a", "b", "c"}
+    assert {r.rows for r in s1} <= {16, 64}
+
+
+def test_schedule_bursty_confines_arrivals_to_the_on_window():
+    s = loadgen.build_schedule(
+        seed=5, duration_s=4.0, rate_qps=50, arrival="bursty",
+        burst_factor=8.0, burst_fraction=0.125, burst_period_s=1.0,
+    )
+    # factor*fraction == 1: ALL arrivals inside the 125 ms on-phase
+    assert s and all((r.t % 1.0) < 0.125 for r in s)
+    # mean rate stays ~rate_qps (Poisson noise around 200 arrivals)
+    assert 120 < len(s) < 300
+
+
+def test_schedule_validation():
+    with pytest.raises(ValueError):
+        loadgen.build_schedule(seed=0, duration_s=1, rate_qps=10,
+                               arrival="diurnal")
+    with pytest.raises(ValueError):
+        loadgen.build_schedule(seed=0, duration_s=0, rate_qps=10)
+    with pytest.raises(ValueError):
+        loadgen.build_schedule(seed=0, duration_s=1, rate_qps=10,
+                               labels=())
+    with pytest.raises(ValueError):
+        loadgen.build_schedule(seed=0, duration_s=1, rate_qps=10,
+                               request_rows=(0,))
+    with pytest.raises(ValueError):
+        loadgen.build_schedule(
+            seed=0, duration_s=1, rate_qps=10, arrival="bursty",
+            burst_factor=10.0, burst_fraction=0.2,
+        )
+
+
+def test_loadgen_run_record_shape(small_index, tmp_path):
+    from randomprojection_tpu.serving import ShardedTopKServer
+
+    path = str(tmp_path / "lg.jsonl")
+    telemetry.configure(path)
+    srv = ShardedTopKServer([small_index], 4, max_delay_s=0.001,
+                            name="lg-test")
+    try:
+        sched = loadgen.build_schedule(
+            seed=9, duration_s=0.4, rate_qps=50,
+            request_rows=(2, 4), labels=("a", "b"),
+        )
+        rec = loadgen.run(srv, sched, code_bytes=8, warmup_rows=2)
+    finally:
+        srv.close()
+        telemetry.shutdown()
+    assert rec["metric"] == "topk_slo"
+    assert rec["requests"] == len(sched)
+    assert rec["schedule_sha256"] == loadgen.schedule_digest(sched)
+    assert rec["rejects"] == 0 and rec["errors"] == 0
+    for table in list(rec["labels"].values()) + [rec["total"]]:
+        assert {"count", "rows", "rejects", "p50_ms", "p90_ms",
+                "p99_ms", "p99.9_ms", "mean_ms", "max_ms"} <= set(table)
+    assert sum(t["count"] for t in rec["labels"].values()) == len(sched)
+    assert rec["total"]["count"] == len(sched)
+    # quantile tables are exact order statistics: monotone by construction
+    for t in rec["labels"].values():
+        if t["count"]:
+            assert t["p50_ms"] <= t["p90_ms"] <= t["p99_ms"] \
+                <= t["p99.9_ms"] <= t["max_ms"]
+    runs = [
+        e for e in telemetry.read_events(path)
+        if e["event"] == EVENTS.LOADGEN_RUN
+    ]
+    assert len(runs) == 1
+    assert runs[0]["schedule_sha256"] == rec["schedule_sha256"]
+
+
+def test_cli_loadgen_identical_seed_identical_schedule(capsys, tmp_path):
+    """Acceptance pin through the REAL CLI: two runs with the identical
+    seed commit topk_slo records whose schedule digests match (and carry
+    per-label quantile tables); a different seed diverges."""
+    out_path = str(tmp_path / "slo.json")
+    args = [
+        "loadgen", "--index-codes", "256", "--code-bytes", "8",
+        "--m", "4", "--rate", "40", "--duration", "0.3",
+        "--request-rows", "2,4", "--labels", "x,y", "--shards", "2",
+    ]
+    digests = []
+    for seed in ("7", "7", "8"):
+        cli.main(args + ["--seed", seed, "--out", out_path])
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        rec = json.loads(line)
+        assert rec["metric"] == "topk_slo"
+        assert rec == json.load(open(out_path))
+        for t in rec["labels"].values():
+            assert {"p50_ms", "p90_ms", "p99_ms", "p99.9_ms"} <= set(t)
+        digests.append(rec["schedule_sha256"])
+    assert digests[0] == digests[1]
+    assert digests[2] != digests[0]
+
+
+def test_cli_loadgen_rejects_bad_flag_combos():
+    with pytest.raises(SystemExit):
+        cli.main(["loadgen", "--request-rows", "abc"])
+    with pytest.raises(SystemExit):
+        cli.main(["loadgen", "--rate", "0.001", "--duration", "0.1"])
+
+
+# -- live smoke (the make verify / CI gate, in-process) ----------------------
+
+
+def test_live_smoke_passes(capsys):
+    """stream-bench with --metrics-port, scraped over real HTTP
+    mid-run: valid OpenMetrics with quantile lines and a nonzero
+    span-derived gauge — the end-to-end acceptance path."""
+    from randomprojection_tpu.utils import live_smoke
+
+    assert live_smoke.main() == 0
+    assert "live-smoke OK" in capsys.readouterr().out

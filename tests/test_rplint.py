@@ -192,6 +192,44 @@ def test_rp02_unregistered_dma_event_caught_against_real_registry():
     assert not suppressed
 
 
+def test_rp02_unregistered_live_plane_events_caught():
+    """ISSUE r17 satellite: rogue ``telemetry.subscriber.*`` /
+    ``serve.latency.*`` / ``loadgen.*`` emits are caught against the
+    REAL shipped registry — the live-plane namespaces have no family
+    prefix, so each event must be individually registered, and the
+    registered dropped/latency/run events in the same fixture stay
+    clean."""
+    real = rplint.load_event_registry(
+        open(os.path.join(
+            rplint.package_root(), "utils", "telemetry.py"
+        )).read()
+    )
+    assert real is not None
+    assert real.knows("telemetry.subscriber.dropped")
+    assert real.knows("serve.latency.request")
+    assert real.knows("loadgen.run")
+    assert not real.knows("serve.latency.rogue_window")
+    active, suppressed = _split(
+        _lint_fixture("rp02_live_bad.py", registry=real)
+    )
+    assert [f.rule for f in active] == ["RP02"] * 3
+    msgs = " | ".join(f.message for f in active)
+    assert "'telemetry.subscriber.rogue_overflow'" in msgs
+    assert "'serve.latency.rogue_window'" in msgs
+    assert "'loadgen.rogue_tick'" in msgs
+    assert not suppressed
+
+
+def test_rp03_rp10_scope_includes_live_plane_modules():
+    """ISSUE r17 satellite: the metrics endpoint and the load generator
+    are hot/concurrency modules — their loops and threads are checked
+    like the four substrates'."""
+    for mod in ("utils/metrics_server.py", "loadgen.py"):
+        assert mod in rplint.HOT_MODULES
+        assert mod in rplint.PIPELINE_MODULES
+        assert mod in rplint.CONCURRENCY_MODULES
+
+
 def test_rp04_zero_and_negative_maxsize_are_unbounded():
     """Python treats any maxsize <= 0 as unbounded — every spelling of
     that must trip RP04, not just the bare constructor."""

@@ -456,10 +456,11 @@ def test_cli_doctor_missing_and_corrupt_files(tmp_path):
 # -- OpenMetrics exposition (acceptance) -------------------------------------
 
 _OM_SAMPLE = __import__("re").compile(
-    r'^[a-zA-Z_][a-zA-Z0-9_]*(\{le="[^"]+"\})? -?[0-9][0-9eE.+-]*$'
+    r'^[a-zA-Z_][a-zA-Z0-9_]*'
+    r'(\{le="[^"]+"\}|\{quantile="[^"]+"\})? -?[0-9][0-9eE.+-]*$'
 )
 _OM_TYPE = __import__("re").compile(
-    r"^# TYPE [a-zA-Z_][a-zA-Z0-9_]* (counter|gauge|histogram)$"
+    r"^# TYPE [a-zA-Z_][a-zA-Z0-9_]* (counter|gauge|histogram|summary)$"
 )
 
 
@@ -498,6 +499,13 @@ def test_openmetrics_exposition_parses():
     # histogram: cumulative buckets at the fixed log2 upper edges, exact
     # sum/count riding along
     assert 'rp_stage_hash_seconds_bucket{le="+Inf"} 3' in lines
+    # r17: the sibling quantile summary rides beside every histogram
+    assert "# TYPE rp_stage_hash_seconds_quantile summary" in lines
+    assert any(
+        line.startswith('rp_stage_hash_seconds_quantile{quantile="0.5"}')
+        for line in lines
+    )
+    assert "rp_stage_hash_seconds_quantile_count 3" in lines
     bucket_lines = [ln for ln in lines if "_bucket{" in ln]
     counts = [int(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
     assert counts == sorted(counts), "bucket counts must be cumulative"
