@@ -42,6 +42,11 @@
 #                      8-device CPU mesh: fused-per-shard == scan ==
 #                      brute force, cross-shard tombstones and >int32
 #                      global ids bit-identical
+#   make ann-smoke     multi-probe LSH candidate tier (ISSUE 15) on the
+#                      interpreter: full-probe coverage == exact ==
+#                      brute force (single-device + 8-shard, cross-shard
+#                      tombstones), the density-fallback rung exact, and
+#                      partial-probe distances true Hamming
 #   make recover-smoke subprocess kill/resume harness at toy shapes:
 #                      SIGKILL the durable ingest at every injected
 #                      point, restart, assert the recovered index is
@@ -63,10 +68,10 @@ PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
 .PHONY: verify lint lint-ci tier1 kernel-smoke transform-smoke shard-smoke \
-        recover-smoke doctor-smoke live-smoke
+        ann-smoke recover-smoke doctor-smoke live-smoke
 
-verify: lint lint-ci kernel-smoke transform-smoke shard-smoke recover-smoke \
-        live-smoke tier1 doctor-smoke
+verify: lint lint-ci kernel-smoke transform-smoke shard-smoke ann-smoke \
+        recover-smoke live-smoke tier1 doctor-smoke
 
 lint:
 	$(PYTHON) -m randomprojection_tpu lint
@@ -120,6 +125,10 @@ transform-smoke:
 shard-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  $(PYTHON) -m randomprojection_tpu.serving.smoke
+
+ann-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) -m randomprojection_tpu.ann.smoke
 
 recover-smoke:
 	rm -rf $(SMOKE_DIR)_recover && mkdir -p $(SMOKE_DIR)_recover

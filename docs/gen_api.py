@@ -95,6 +95,7 @@ def main():
         trace_report,
     )
     import randomprojection_tpu.loadgen as loadgen
+    import randomprojection_tpu.ann as ann
 
     for title, mod in [
         ("`randomprojection_tpu.streaming`", streaming),
@@ -111,6 +112,7 @@ def main():
         ("`randomprojection_tpu.utils.trace_report`", trace_report),
         ("`randomprojection_tpu.utils.metrics_server`", metrics_server),
         ("`randomprojection_tpu.loadgen`", loadgen),
+        ("`randomprojection_tpu.ann`", ann),
         ("`randomprojection_tpu.analysis.rplint`", rplint),
         ("`randomprojection_tpu.analysis.cfg`", analysis_cfg),
         ("`randomprojection_tpu.analysis.flowrules`", analysis_flowrules),

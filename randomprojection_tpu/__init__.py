@@ -45,6 +45,13 @@ _LAZY_DURABLE = (
 
 _LAZY_SERVING = ("ShardedSimHashIndex", "ShardedTopKServer", "shard_devices")
 
+_LAZY_ANN = (
+    "LSHSimHashIndex",
+    "LSHShardedSimHashIndex",
+    "load_lsh_index",
+    "load_lsh_sharded_index",
+)
+
 __all__ = [
     "johnson_lindenstrauss_min_dim",
     "DataDimensionalityWarning",
@@ -52,6 +59,7 @@ __all__ = [
     *_LAZY_ESTIMATORS,
     *_LAZY_DURABLE,
     *_LAZY_SERVING,
+    *_LAZY_ANN,
 ]
 
 
@@ -70,4 +78,8 @@ def __getattr__(name):
         from randomprojection_tpu import serving
 
         return getattr(serving, name)
+    if name in _LAZY_ANN:
+        from randomprojection_tpu import ann
+
+        return getattr(ann, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -205,6 +205,10 @@ HOT_MODULES = (
     # a hidden host sync in either falsifies what they observe
     "utils/metrics_server.py",
     "loadgen.py",
+    # r18 LSH candidate tier: probe + gather + re-rank is the new
+    # serving hot loop — a hidden host sync there re-serializes exactly
+    # the dispatch/d2h overlap the tier inherits from query_topk
+    "ann/lsh.py",
 )
 # RP06: modules on the pipeline/serving path where a swallowed error
 # strands a stream, a future, or a telemetry file

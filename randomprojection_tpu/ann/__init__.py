@@ -1,0 +1,32 @@
+"""Multi-probe LSH candidate tier (ISSUE 15; ROADMAP open item 2).
+
+Sublinear top-k retrieval over the SimHash serving stack: banded CSR
+bucket indexes over the packed codes, multi-probe candidate generation
+(probe count = the recall/q-s knob), exact-Hamming re-rank of ONLY the
+candidates through the r12 fused kernel, and a fallback ladder that
+never serves worse than the exact scan.  See ``lsh.py`` for the band
+key / perturbation-order / durability arguments, and
+docs/ARCHITECTURE.md "Multi-probe LSH candidate tier".
+"""
+
+from randomprojection_tpu.ann.lsh import (
+    BandedBuckets,
+    BandPlan,
+    LSHShardedSimHashIndex,
+    LSHSimHashIndex,
+    band_keys,
+    load_lsh_index,
+    load_lsh_sharded_index,
+    probe_masks,
+)
+
+__all__ = [
+    "BandPlan",
+    "band_keys",
+    "probe_masks",
+    "BandedBuckets",
+    "LSHSimHashIndex",
+    "LSHShardedSimHashIndex",
+    "load_lsh_index",
+    "load_lsh_sharded_index",
+]

@@ -1,0 +1,1021 @@
+"""Multi-probe LSH candidate tier over SimHash bucket indexes (ISSUE 15).
+
+Every query so far was an exact linear Hamming scan: the r12 fused
+kernel made the scan fast and r13 spread it over shards, but at the
+BL:10 billion-code scale each query still touches every code, so q/s is
+bounded by corpus bandwidth no matter how good the kernel gets.
+SimHash codes ARE an LSH family (Charikar 2002; multi-probe after Lv et
+al. 2007): two codes that agree on a contiguous ``b``-bit **band** of
+their sign bits are close with probability rising steeply as their
+angle shrinks, so bucketing every code by ``L`` disjoint band keys
+turns candidate generation into ``O(candidates)`` bucket lookups — the
+exact kernel then re-ranks ONLY the candidates.
+
+The tier, bottom to top:
+
+- **Band keys** (``band_keys``) — code bits ``[j·b, (j+1)·b)`` of each
+  packed code word form band ``j``'s key (little-endian bit order,
+  matching ``np.packbits(bitorder='little')``).  A pure function of the
+  codes, so the banded index is always rebuildable from a snapshot.
+- **Banded CSR buckets** (``BandedBuckets``) — per band, a counting-
+  sorted CSR layout ``indptr (2^b + 1) → ids`` with ids ascending
+  within every bucket.  ``add`` merges new rows *incrementally*: only
+  the new rows' keys are extracted and counting-sorted, then spliced
+  into the existing CSR by a vectorized two-way merge — resident rows
+  are never re-hashed.  Host-resident by design: the index is O(L·n)
+  int32 beside an O(n·n_bytes) corpus, and the per-query probe work is
+  O(L·P) ``searchsorted``-free pointer lookups.
+- **Multi-probe** (``probe_masks``) — each band probes its exact bucket
+  plus the nearest ``P-1`` perturbations: XOR masks in (popcount,
+  ascending value) order, the uniform-confidence specialization of
+  Lv et al.'s score order (packed codes carry sign bits only — no
+  per-bit projection magnitudes survive the sketch, so every bit is
+  equally confident and the perturbation order is data-independent and
+  deterministic).  ``P ≥ 2^b`` probes every bucket of every band —
+  full probe coverage — which makes the candidate set the whole live
+  corpus and the result **bit-identical to brute force** (the parity
+  discipline every kernel round ships under; ``make ann-smoke``).
+- **Exact re-rank** (``LSHSimHashIndex.query_topk``) — per query tile,
+  candidates deduplicate across bands, probes and the tile's queries
+  (one sorted ``np.unique`` union; ascending global id order is what
+  makes the re-rank's local tie-break equal the documented
+  (distance, lower-global-id) order), tombstoned rows are filtered,
+  the candidate code rows are gathered ON DEVICE from the resident
+  chunks, and the r12 fused kernel scores the tile against them —
+  in-kernel DMA'd Hamming matmul + bitonic running top-m, exactly the
+  machinery the full scan uses, on 1/10th (or 1/1000th) of the rows.
+- **Fallback ladder** — the tier NEVER serves worse than the exact
+  path: a tile whose candidate union is too dense (``> fallback_density
+  · n_live`` — re-rank would approach scan cost) or too starved
+  (``< m`` — the result could not fill) falls back to the exact
+  device ladder for that tile, recorded as ``index.lsh.fallback``;
+  a scoped-VMEM OOM in the re-rank kernel degrades to a device-Hamming
+  + host-select rung (same order, same results).  ``probes=0`` pins
+  the exact path outright.
+
+Sharding: ``LSHShardedSimHashIndex`` builds one banded index per shard
+(the shard hook ``ShardedSimHashIndex._make_shard``), probes and
+re-ranks per shard, and merges per-shard candidates through the SAME
+``_merge_tile`` lexsort as the exact tier — cross-shard tombstones and
+``id_offset`` global ids carry over unchanged.  Serving: both classes
+keep the ``query_topk(A, m, tile=)`` surface, so they plug directly
+into ``TopKServer`` / ``ShardedTopKServer`` — the micro-batcher fans
+coalesced batches into the LSH tier with no server changes.
+
+Durability: band keys persist beside the chunks in the r11 manifest
+(``lsh-<gen>.npy``, SHA-256-checksummed, **global id order** — so the
+spill is layout-fungible exactly like r13 sharded snapshots), and
+loading verifies the persisted keys against keys rebuilt from the
+restored codes — corruption or extraction drift is a loud
+``ValueError``, never a silently-wrong bucket index.  A pre-LSH
+(r11-format) snapshot loads cleanly with the index rebuilt from codes.
+
+Telemetry: ``index.lsh.dispatch`` (probe counts, candidate fraction),
+``index.lsh.fallback`` (reason — the doctor's degraded audit),
+``index.lsh.build`` (bucket folds) — all in ``telemetry.EVENTS`` and
+consumed by ``trace_report``'s candidate-generation section.
+"""
+
+from __future__ import annotations
+
+import itertools
+import numbers
+import os
+from typing import Optional
+
+import numpy as np
+
+from randomprojection_tpu.models.sketch import (
+    SimHashIndex,
+    _hamming_tile_fn,
+    _host_topk_select,
+    _start_host_copy,
+)
+from randomprojection_tpu.serving.sharded_index import ShardedSimHashIndex
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+__all__ = [
+    "BandPlan",
+    "band_keys",
+    "probe_masks",
+    "BandedBuckets",
+    "LSHSimHashIndex",
+    "LSHShardedSimHashIndex",
+    "load_lsh_index",
+    "load_lsh_sharded_index",
+]
+
+# bucket-space ceiling: indptr is (2^b + 1) int64 per band — b=20 is
+# 8 MB/band, past which the CSR pointer array stops being "beside the
+# corpus" and becomes a corpus of its own
+_MAX_BAND_BITS = 20
+# band-key extraction block: bounds the unpacked bit matrix to
+# ~2 MB/256-bit codes however large one add() is
+_KEY_EXTRACT_BLOCK = 1 << 16
+
+
+class BandPlan:
+    """Resolved band layout: ``bands`` disjoint ``band_bits``-bit key
+    slices over the leading ``bands·band_bits`` code bits.
+
+    Defaults: ``band_bits = min(16, n_bits)`` (65536 buckets — sparse
+    at any per-shard corpus size that fits int32 ids) and ``bands =
+    min(8, n_bits // band_bits)`` (8 independent collision chances per
+    probe).  Bands must fit the real bit count — ragged codes (e.g. 20
+    bits in 3 bytes) never key on pad bits."""
+
+    __slots__ = ("n_bits", "bands", "band_bits")
+
+    def __init__(self, n_bits: int, *, bands: Optional[int] = None,
+                 band_bits: Optional[int] = None):
+        n_bits = int(n_bits)
+        if n_bits < 1:
+            raise ValueError(f"n_bits must be >= 1, got {n_bits}")
+        if band_bits is None:
+            band_bits = min(16, n_bits)
+        band_bits = int(band_bits)
+        if not 1 <= band_bits <= _MAX_BAND_BITS:
+            raise ValueError(
+                f"band_bits must be in [1, {_MAX_BAND_BITS}], got "
+                f"{band_bits}"
+            )
+        if bands is None:
+            bands = max(1, min(8, n_bits // band_bits))
+        bands = int(bands)
+        if bands < 1:
+            raise ValueError(f"bands must be >= 1, got {bands}")
+        if bands * band_bits > n_bits:
+            raise ValueError(
+                f"bands={bands} x band_bits={band_bits} needs "
+                f"{bands * band_bits} code bits but the codes carry only "
+                f"{n_bits}; bands are disjoint slices of the real bits"
+            )
+        self.n_bits = n_bits
+        self.bands = bands
+        self.band_bits = band_bits
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, BandPlan)
+            and (self.n_bits, self.bands, self.band_bits)
+            == (other.n_bits, other.bands, other.band_bits)
+        )
+
+    def __repr__(self):  # pragma: no cover — debugging aid
+        return (
+            f"BandPlan(n_bits={self.n_bits}, bands={self.bands}, "
+            f"band_bits={self.band_bits})"
+        )
+
+
+def band_keys(codes, plan: BandPlan) -> np.ndarray:
+    """Band keys of packed codes: ``(bands, n)`` uint32, key ``j`` of a
+    row being its code bits ``[j·b, (j+1)·b)`` (little-endian within
+    each byte, matching ``np.packbits(bitorder='little')`` and the
+    Hamming kernels).  Pure host function of the codes — the banded
+    index is always rebuildable from any snapshot."""
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    if codes.ndim != 2:
+        raise ValueError(f"codes must be (n, nbytes), got {codes.shape}")
+    n = codes.shape[0]
+    b = plan.band_bits
+    out = np.empty((plan.bands, n), np.uint32)
+    w = np.uint32(1) << np.arange(b, dtype=np.uint32)
+    for lo in range(0, n, _KEY_EXTRACT_BLOCK):
+        hi = min(lo + _KEY_EXTRACT_BLOCK, n)
+        bits = np.unpackbits(codes[lo:hi], axis=1, bitorder="little")
+        for j in range(plan.bands):
+            sl = bits[:, j * b : (j + 1) * b].astype(np.uint32)
+            out[j, lo:hi] = (sl * w[None, :]).sum(axis=1, dtype=np.uint32)
+    return out
+
+
+def probe_masks(band_bits: int, probes: int) -> np.ndarray:
+    """The first ``probes`` XOR masks of the multi-probe perturbation
+    sequence: the exact bucket first, then masks in (popcount,
+    ascending value) order — flip one bit before two, lower bit
+    positions before higher.  With sign-only codes every bit is equally
+    confident, so this is the uniform-confidence specialization of the
+    Lv et al. score order: deterministic, data-independent, and total
+    (``probes ≥ 2^band_bits`` enumerates every bucket — full probe
+    coverage)."""
+    if not isinstance(probes, numbers.Integral) or probes < 1:
+        raise ValueError(f"probes must be a positive int, got {probes!r}")
+    band_bits = int(band_bits)
+    probes = int(min(probes, 1 << band_bits))
+    out = [0]
+    flips = 1
+    while len(out) < probes and flips <= band_bits:
+        vals = sorted(
+            sum(1 << p for p in combo)
+            for combo in itertools.combinations(range(band_bits), flips)
+        )
+        out.extend(vals[: probes - len(out)])
+        flips += 1
+    return np.asarray(out, dtype=np.uint32)
+
+
+class BandedBuckets:
+    """Per-band CSR inverted bucket index over one shard's local id
+    space (see module docstring).
+
+    State per band: ``indptr`` ``(2^b + 1,)`` int64 and ``ids`` ``(n,)``
+    int32, counting-sorted by bucket with ids ASCENDING within every
+    bucket — the invariant that makes candidate unions id-sorted and
+    the re-rank tie-break exact.  ``keys`` ``(bands, n)`` uint32 holds
+    every row's band keys in id order: the persisted durable state
+    (layout-fungible — id order IS the snapshot order) and what
+    ``compact()``'s id remap folds without re-extraction."""
+
+    __slots__ = ("plan", "n", "keys", "_indptr", "_ids")
+
+    def __init__(self, plan: BandPlan):
+        self.plan = plan
+        self.n = 0
+        self.keys = np.empty((plan.bands, 0), np.uint32)
+        nb = 1 << plan.band_bits
+        self._indptr = [
+            np.zeros(nb + 1, np.int64) for _ in range(plan.bands)
+        ]
+        self._ids = [np.empty(0, np.int32) for _ in range(plan.bands)]
+
+    @classmethod
+    def from_keys(cls, plan: BandPlan, keys: np.ndarray) -> "BandedBuckets":
+        """Rebuild from a persisted/remapped key matrix (one counting
+        sort per band — no code bytes touched)."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint32)
+        if keys.ndim != 2 or keys.shape[0] != plan.bands:
+            raise ValueError(
+                f"keys must be ({plan.bands}, n), got {keys.shape}"
+            )
+        b = cls(plan)
+        b._append_keys(keys)
+        return b
+
+    def add(self, codes) -> int:
+        """Fold new rows (appended at local ids ``[n, n+rows)``) into
+        every band's CSR — extracts keys for the NEW rows only and
+        splices them in with a vectorized merge; resident rows are
+        never re-hashed.  Returns the number of rows folded."""
+        new_keys = band_keys(codes, self.plan)
+        self._append_keys(new_keys)
+        return new_keys.shape[1]
+
+    def _append_keys(self, new_keys: np.ndarray) -> None:
+        m = new_keys.shape[1]
+        if m == 0:
+            return
+        row0 = self.n
+        if row0 + m > 2**31 - 1:
+            raise ValueError(
+                "BandedBuckets ids are int32 (the per-shard id space); "
+                f"have {row0}, adding {m} would overflow"
+            )
+        nb = 1 << self.plan.band_bits
+        for j in range(self.plan.bands):
+            k = new_keys[j].astype(np.int64)
+            counts = np.bincount(k, minlength=nb)
+            csum = np.concatenate(([0], np.cumsum(counts)))
+            old_indptr = self._indptr[j]
+            old_ids = self._ids[j]
+            old_counts = np.diff(old_indptr)
+            indptr = old_indptr + csum
+            out = np.empty(old_ids.size + m, np.int32)
+            if old_ids.size:
+                # old bucket k's run shifts right by the number of new
+                # rows landing in buckets < k (csum[k])
+                shift = np.repeat(csum[:-1], old_counts)
+                out[np.arange(old_ids.size, dtype=np.int64) + shift] = (
+                    old_ids
+                )
+            # stable sort groups new rows by bucket keeping id order —
+            # within-bucket ids stay ascending, and every new id is
+            # greater than every old id, so the invariant holds
+            order = np.argsort(k, kind="stable")
+            grp_start = np.repeat(csum[:-1], counts)
+            within = np.arange(m, dtype=np.int64) - grp_start
+            dest = np.repeat(indptr[:-1] + old_counts, counts) + within
+            out[dest] = (row0 + order).astype(np.int32)
+            self._indptr[j] = indptr
+            self._ids[j] = out
+        self.keys = np.concatenate([self.keys, new_keys], axis=1)
+        self.n += m
+
+    def candidates(self, qkeys: np.ndarray, masks: np.ndarray):
+        """Union candidate ids for one query tile: probe bucket
+        ``qkey ^ mask`` in every band for every perturbation mask,
+        gather the bucket runs, and deduplicate across bands, probes
+        AND the tile's queries.  Returns ``(ids, gathered)`` — ``ids``
+        sorted ascending int32 (``np.unique``), ``gathered`` the
+        pre-dedup candidate count (the duplication factor is a bucket-
+        quality signal the dispatch event records)."""
+        parts = []
+        gathered = 0
+        for j in range(self.plan.bands):
+            buckets = (
+                (qkeys[j][:, None] ^ masks[None, :])
+                .ravel()
+                .astype(np.int64)
+            )
+            indptr = self._indptr[j]
+            starts = indptr[buckets]
+            lens = indptr[buckets + 1] - starts
+            total = int(lens.sum())
+            if total == 0:
+                continue
+            csum = np.concatenate(([0], np.cumsum(lens)))
+            take = np.repeat(starts - csum[:-1], lens) + np.arange(
+                total, dtype=np.int64
+            )
+            parts.append(self._ids[j][take])
+            gathered += total
+        if not parts:
+            return np.empty(0, np.int32), 0
+        return np.unique(np.concatenate(parts)), gathered
+
+    def bucket_ids(self, band: int, key: int) -> np.ndarray:
+        """One bucket's id run (ascending) — introspection/testing."""
+        indptr = self._indptr[band]
+        return self._ids[band][indptr[key] : indptr[key + 1]].copy()
+
+
+def _check_probes(probes, default: int) -> int:
+    """Per-call ``probes`` resolution, validated like the constructor
+    knob (a float would silently truncate to fewer probes than the
+    caller computed): None → the serving default, else a non-negative
+    int (0 = the exact path)."""
+    if probes is None:
+        return default
+    if not isinstance(probes, numbers.Integral) or probes < 0:
+        raise ValueError(
+            f"probes must be a non-negative int, got {probes!r}"
+        )
+    return int(probes)
+
+
+class LSHSimHashIndex(SimHashIndex):
+    """``SimHashIndex`` with a banded multi-probe LSH candidate tier:
+    ``query_topk`` probes the banded bucket index, exact-Hamming
+    re-ranks only the candidates through the r12 fused kernel, and
+    falls back to the exact device ladder whenever the candidate set is
+    too dense or too starved — the tier never serves worse than the
+    exact path (see module docstring).
+
+    ``probes`` is the recall/q-s knob: perturbation buckets probed per
+    band (1 = exact bucket only; ``2^band_bits`` = full coverage =
+    bit-identical to brute force).  The constructor value is the
+    serving default — a ``TopKServer`` coalescing onto this index uses
+    it — and ``query_topk(probes=...)`` overrides per call (``0`` pins
+    the exact scan path).  ``fallback_density`` is the ladder
+    threshold: a tile whose candidate union exceeds that fraction of
+    the live corpus re-ranks at near-scan cost, so it serves through
+    the exact path instead.
+
+    The bucket index maintains itself through every mutation path:
+    ``add`` folds new rows incrementally, ``delete`` needs no bucket
+    work (tombstones filter at re-rank), ``compact`` folds the id
+    remap, and snapshot restore rebuilds (verifying against persisted
+    keys when the snapshot carries them).  Single-device by
+    construction (one LSH index is one shard) — the sharded tier is
+    ``LSHShardedSimHashIndex``."""
+
+    def __init__(self, codes, *, bands: Optional[int] = None,
+                 band_bits: Optional[int] = None, probes: int = 8,
+                 fallback_density: float = 0.1, **kw):
+        if kw.get("mesh") is not None:
+            raise ValueError(
+                "LSHSimHashIndex is single-device (one banded index is "
+                "one shard); shard a corpus with ann.LSHShardedSimHashIndex"
+            )
+        if not isinstance(probes, numbers.Integral) or probes < 1:
+            raise ValueError(
+                f"probes must be a positive int, got {probes!r}"
+            )
+        if not 0.0 < float(fallback_density) <= 1.0:
+            raise ValueError(
+                f"fallback_density must be in (0, 1], got "
+                f"{fallback_density!r}"
+            )
+        self.probes = int(probes)
+        self.fallback_density = float(fallback_density)
+        self._lsh_cfg = (bands, band_bits)
+        self._lsh_suspend = False
+        self._masks_cache: dict = {}
+        # scoped-VMEM OOM memo for the re-rank kernel (r6 convention,
+        # mirroring _fused_degraded): a (nq, rows_pad, m) shape that
+        # OOM'd once serves the host rung for the process lifetime
+        # instead of re-paying the failed dispatch per tile
+        self._lsh_fused_degraded: set = set()
+        # resolve the band plan BEFORE the base constructor uploads the
+        # bulk chunk, so the append hook folds rows directly — no
+        # deferred copy of the corpus (which at the BL:10 scale would
+        # transiently double host memory).  n_bits mirrors the base
+        # resolution; the base constructor still owns its validation.
+        codes = np.asarray(codes, dtype=np.uint8)
+        if codes.ndim != 2:
+            raise ValueError(f"codes must be (n, nbytes), got {codes.shape}")
+        n_bits = kw.get("n_bits")
+        n_bits = codes.shape[1] * 8 if n_bits is None else int(n_bits)
+        self.band_plan = BandPlan(n_bits, bands=bands, band_bits=band_bits)
+        self._buckets = BandedBuckets(self.band_plan)
+        super().__init__(codes, **kw)
+
+    # -- bucket maintenance (hooks off the base mutation paths) --------------
+
+    def _codes_appended(self, codes: np.ndarray, row0: int) -> None:
+        if self._lsh_suspend:
+            return
+        self._lsh_fold(codes)
+
+    def _lsh_fold(self, codes: np.ndarray) -> None:
+        rows = self._buckets.add(codes)
+        telemetry.registry().counter_inc("index.lsh.builds")
+        telemetry.emit(
+            EVENTS.INDEX_LSH_BUILD, rows=int(rows),
+            n=int(self._buckets.n), bands=self.band_plan.bands,
+            band_bits=self.band_plan.band_bits,
+        )
+
+    def _rebuild_from_host(self, codes: np.ndarray) -> None:
+        # a wholesale replacement (compact(), durable re-compaction)
+        # starts the banded index over unless compact() is folding the
+        # id remap itself (suspended — see compact())
+        if not self._lsh_suspend and self._buckets is not None:
+            self._buckets = BandedBuckets(self.band_plan)
+        super()._rebuild_from_host(codes)
+
+    def compact(self) -> np.ndarray:
+        """Fold tombstones/chunks exactly like the base ``compact``,
+        then fold the returned old→new id mapping through the banded
+        index: surviving rows keep their extracted band keys
+        (``keys[:, mapping]``), renumbered compactly — no re-hash of
+        the corpus."""
+        old_keys = self._buckets.keys if self._buckets is not None else None
+        self._lsh_suspend = True
+        try:
+            mapping = super().compact()
+        finally:
+            self._lsh_suspend = False
+        if old_keys is not None:
+            self._buckets = BandedBuckets.from_keys(
+                self.band_plan, old_keys[:, mapping]
+            )
+            telemetry.registry().counter_inc("index.lsh.builds")
+            telemetry.emit(
+                EVENTS.INDEX_LSH_BUILD, rows=int(self._buckets.n),
+                n=int(self._buckets.n), bands=self.band_plan.bands,
+                band_bits=self.band_plan.band_bits, remapped=True,
+            )
+        return mapping
+
+    # -- durable persistence (see durable.save_index's extra hook) -----------
+
+    def _durable_extra(self, dirpath: str, gen: int) -> dict:
+        """Manifest extras for ``durable.save_index``: spill the band
+        keys (id order — layout-fungible) beside the chunks,
+        checksummed like them, plus the band layout and serving knobs
+        so ``load_lsh_index`` restores the identical tier."""
+        return _spill_lsh_keys(self, dirpath, gen, self._buckets.keys)
+
+    @classmethod
+    def load(cls, path: str, *, bands: Optional[int] = None,
+             band_bits: Optional[int] = None,
+             probes: Optional[int] = None,
+             fallback_density: Optional[float] = None,
+             mesh=None, data_axis: str = "data"):
+        """Restore an LSH index from a snapshot directory — LSH-format
+        or pre-LSH r11-format (the banded index is then rebuilt from
+        the codes).  See ``load_lsh_index``."""
+        if mesh is not None:
+            raise ValueError(
+                "LSHSimHashIndex is single-device; load a sharded "
+                "snapshot with ann.load_lsh_sharded_index"
+            )
+        return load_lsh_index(
+            path, bands=bands, band_bits=band_bits, probes=probes,
+            fallback_density=fallback_density,
+        )
+
+    # -- the candidate tier --------------------------------------------------
+
+    def _probe_masks(self, probes: int) -> np.ndarray:
+        masks = self._masks_cache.get(probes)
+        if masks is None:
+            masks = probe_masks(self.band_plan.band_bits, probes)
+            self._masks_cache[probes] = masks
+        return masks
+
+    def lsh_stats(self) -> dict:
+        """Process-registry candidate-tier tallies (shared across
+        same-process indexes, like every registry counter)."""
+        reg = telemetry.registry()
+        return {
+            "dispatches": reg.counter("index.lsh.dispatches"),
+            "fallbacks": reg.counter("index.lsh.fallbacks"),
+            "candidates": reg.counter("index.lsh.candidates"),
+            "probe_buckets": reg.counter("index.lsh.probe_buckets"),
+            "builds": reg.counter("index.lsh.builds"),
+        }
+
+    def query_topk(self, A, m: int, *, tile: int = 2048,
+                   probes: Optional[int] = None):
+        """Top-``m`` via the candidate tier: same contract as
+        ``SimHashIndex.query_topk`` — ``(dist, idx)`` int32, ``m_eff =
+        min(m, n_live)`` columns, (distance, lower-global-id) order —
+        but each tile touches only its candidate union unless the
+        fallback ladder routes it to the exact path.  ``probes``
+        overrides the serving default (``0`` = exact path; ``tile`` is
+        also the candidate-union granularity — smaller tiles mean
+        per-query-sharper candidate sets at more dispatches).
+
+        Determinism under PARTIAL probes is per (query set, tile):
+        the candidate union is tile-scoped, so grouping a query with
+        different neighbors (a different ``tile``, or a coalescing
+        server padding/batching requests) can ENLARGE its candidate
+        set.  The effect is monotone — a superset of candidates can
+        only return equal-or-closer answers, never displace a correct
+        one — and vanishes at full probe coverage, where the union is
+        the whole live corpus regardless of grouping."""
+        p = _check_probes(probes, self.probes)
+        if p == 0:
+            return super().query_topk(A, m, tile=tile)
+        if not isinstance(m, numbers.Integral) or m <= 0:
+            raise ValueError(f"m must be a positive int, got {m!r}")
+        A = self._check_queries(A)
+        if self.n_codes == 0:
+            raise ValueError("query_topk on an empty index")
+        if self.n_live == 0:
+            raise ValueError(
+                "query_topk on an index whose codes are all deleted "
+                "(tombstoned); compact() or add() live codes first"
+            )
+        m_eff = int(min(m, self.n_live))
+        masks = self._probe_masks(p)
+        nq = A.shape[0]
+        out_d = np.empty((nq, m_eff), dtype=np.int32)
+        out_i = np.empty((nq, m_eff), dtype=np.int32)
+        # same one-behind overlap as the exact path: tile i's d2h +
+        # select ride under tile i+1's probe/gather/dispatch
+        pending: list = []  # [(lo, hi, kind, payload)]
+
+        def finish(entry):
+            lo, hi, kind, payload = entry
+            if kind == "lsh":
+                d, i = self._lsh_finish_tile(payload, m_eff)
+            elif kind == "exact":
+                d, i = self._topk_finish_tile(payload, m_eff)
+            else:  # 'done': served synchronously (dense host rung)
+                d, i = payload
+            out_d[lo:hi] = d
+            out_i[lo:hi] = i
+
+        for lo in range(0, nq, tile):
+            hi = min(lo + tile, nq)
+            kind, payload = self._lsh_dispatch_tile(
+                A[lo:hi], m_eff, masks, tile
+            )
+            pending.append((lo, hi, kind, payload))
+            if len(pending) >= 2:
+                finish(pending.pop(0))
+        while pending:
+            finish(pending.pop(0))
+        return out_d, out_i
+
+    def _lsh_dispatch_tile(self, a_np, m_eff: int, masks: np.ndarray,
+                           tile: int):
+        """Candidate generation + re-rank dispatch for one query tile.
+        Returns ``(kind, payload)``: ``('lsh', ...)`` for a dispatched
+        candidate re-rank, ``('exact', handles)`` when the ladder fell
+        back to the exact device fan-out, ``('done', (d, i))`` when the
+        exact path itself is host-scale (dense rung).  Shared with the
+        sharded tier, which calls it per shard."""
+        qkeys = band_keys(a_np, self.band_plan)
+        cand, gathered = self._buckets.candidates(qkeys, masks)
+        if self._dead is not None and cand.size:
+            # tombstones filter at re-rank: a deleted code is never
+            # gathered, so it can never win (ISSUE 15 storage contract)
+            cand = cand[~self._dead[cand]]
+        n_cand = int(cand.size)
+        nq = int(a_np.shape[0])
+        n_probes = nq * self.band_plan.bands * int(masks.size)
+        reg = telemetry.registry()
+        if n_cand < m_eff or n_cand > self.fallback_density * self.n_live:
+            reason = "starved" if n_cand < m_eff else "dense"
+            reg.counter_inc("index.lsh.fallbacks")
+            telemetry.emit(
+                EVENTS.INDEX_LSH_FALLBACK, reason=reason, queries=nq,
+                probes=int(masks.size), candidates=n_cand,
+                n_live=int(self.n_live),
+                threshold=self.fallback_density,
+                **telemetry.trace_fields(),
+            )
+            if self._topk_route(nq, m_eff) == "dense":
+                # host-scale request: the exact path serves it whole
+                return "done", SimHashIndex.query_topk(
+                    self, a_np, m_eff, tile=tile
+                )
+            return "exact", self._topk_dispatch_tile(a_np, m_eff)
+        frac = n_cand / max(self.n_live, 1)
+        reg.counter_inc("index.lsh.dispatches")
+        reg.counter_inc("index.lsh.probe_buckets", n_probes)
+        reg.counter_inc("index.lsh.candidates", n_cand)
+        reg.gauge_set("index.lsh.candidate_fraction", frac)
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.INDEX_LSH_DISPATCH, queries=nq, m=int(m_eff),
+                probes=int(masks.size), bands=self.band_plan.bands,
+                candidates=n_cand, gathered=int(gathered),
+                candidate_fraction=round(frac, 6),
+                **telemetry.trace_fields(),
+            )
+        return "lsh", self._lsh_rerank_dispatch(a_np, cand, m_eff)
+
+    def _gather_codes_device(self, cand: np.ndarray):
+        """Gather the candidate code rows ON DEVICE from the resident
+        chunks (no host copy of any code byte) and zero-pad to the row
+        bucket so the re-rank kernel compiles one program per bucket,
+        not one per candidate count."""
+        import jax.numpy as jnp
+
+        from randomprojection_tpu.parallel.sharded import row_bucket
+
+        parts = []
+        base = 0
+        for c in self._chunks:
+            lo = np.searchsorted(cand, base)
+            hi = np.searchsorted(cand, base + c.n)
+            if hi > lo:
+                local = self._device_queries(
+                    (cand[lo:hi] - base).astype(np.int32)
+                )
+                parts.append(jnp.take(c.b, local, axis=0))
+            base += c.n
+        g = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        pad_to = row_bucket(int(cand.size))
+        if pad_to != cand.size:
+            g = jnp.pad(g, ((0, pad_to - cand.size), (0, 0)))
+        return g
+
+    def _lsh_rerank_dispatch(self, a_np, cand: np.ndarray, m_eff: int):
+        """Dispatch the exact re-rank of one tile against its gathered
+        candidates and START the d2h.  Default rung: the r12 fused
+        Pallas kernel (in-kernel DMA'd Hamming matmul + bitonic running
+        top-m — the same machinery the full scan uses).  A scoped-VMEM
+        OOM, or a shape the planner cannot tile, degrades to one device
+        Hamming dispatch + host select — same (dist, lower-id) order,
+        same results (the candidate set is small by construction, the
+        density gate bounds it)."""
+        from randomprojection_tpu.ops import topk_kernels
+
+        a = self._device_queries(a_np)
+        cand_dev = self._gather_codes_device(cand)
+        n_cand = int(cand.size)
+        shape_key = (int(a_np.shape[0]), int(cand_dev.shape[0]), m_eff)
+        plan = None
+        if shape_key not in self._lsh_fused_degraded:
+            plan = topk_kernels.plan_fused(*shape_key[:2], self.n_bytes,
+                                           shape_key[2])
+        if plan is not None:
+            from randomprojection_tpu.ops.pallas_kernels import (
+                is_vmem_oom,
+                record_vmem_oom_retry,
+            )
+
+            try:
+                d, i = topk_kernels.fused_topk(
+                    a, cand_dev, n_cand, m_eff, plan=plan
+                )
+                _start_host_copy(d)
+                _start_host_copy(i)
+                return ("fused", d, i, cand)
+            except Exception as e:
+                if not is_vmem_oom(e):
+                    raise
+                # degraded retry, r6 convention: memoize only after the
+                # failure is classified — this shape serves the host
+                # rung for the process lifetime, never re-paying the
+                # failed dispatch per tile
+                record_vmem_oom_retry(a_np.shape, "lsh_rerank", m_eff)
+                self._lsh_fused_degraded.add(shape_key)
+        d = _hamming_tile_fn()(a, cand_dev)
+        _start_host_copy(d)
+        return ("host", d, None, cand)
+
+    def _lsh_finish_tile(self, payload, m_eff: int):
+        """Materialize one re-rank dispatch and map candidate-local
+        positions back to global ids.  ``cand`` is ascending, so the
+        kernel's lower-local-id tie-break IS the documented
+        lower-global-id order."""
+        kind, d, i, cand = payload
+        if kind == "fused":
+            # d2h already started at dispatch: these materialize the
+            # prefetched copy, one tile behind the live dispatch
+            dist = np.asarray(d)
+            idx = np.asarray(i)
+            return dist, cand[idx].astype(np.int32)
+        # host-select rung: distances over the padded candidate rows —
+        # slice the pad columns off before the exact host selection
+        # (d2h started at dispatch, same one-behind contract)
+        D = np.asarray(d)[:, : cand.size]
+        dloc, iloc = _host_topk_select(D, m_eff)
+        return dloc, cand[iloc].astype(np.int32)
+
+
+class LSHShardedSimHashIndex(ShardedSimHashIndex):
+    """``ShardedSimHashIndex`` whose shards carry banded multi-probe
+    LSH tiers: a query tile probes EVERY shard's bucket index, each
+    shard exact-re-ranks its own candidates (full per-shard fallback
+    ladder — a dense shard falls back to its exact scan while its
+    neighbors stay sublinear), and the per-shard candidates merge
+    through the same ``_merge_tile`` lexsort as the exact tier — so
+    cross-shard tombstones, int64 global ids and ``id_offset`` behave
+    identically, and full probe coverage is bit-identical to
+    ``topk_bruteforce`` on the concatenated corpus.
+
+    Plugs into ``ShardedTopKServer`` unchanged (the ``query_topk``
+    surface is the contract); ``probes`` at construction is the serving
+    default, per-call ``probes=`` overrides, ``0`` pins the exact
+    path."""
+
+    def __init__(self, codes, *, bands: Optional[int] = None,
+                 band_bits: Optional[int] = None, probes: int = 8,
+                 fallback_density: float = 0.1, **kw):
+        if not isinstance(probes, numbers.Integral) or probes < 1:
+            raise ValueError(
+                f"probes must be a positive int, got {probes!r}"
+            )
+        if not 0.0 < float(fallback_density) <= 1.0:
+            raise ValueError(
+                f"fallback_density must be in (0, 1], got "
+                f"{fallback_density!r}"
+            )
+        self.probes = int(probes)
+        self.fallback_density = float(fallback_density)
+        self._lsh_cfg = (bands, band_bits)
+        super().__init__(codes, **kw)
+        self.band_plan = self._shards[0].band_plan
+
+    def _make_shard(self, s: int, dev):
+        bands, band_bits = self._lsh_cfg
+        return LSHSimHashIndex(
+            np.empty((0, self.n_bytes), np.uint8),
+            n_bits=self.n_bits, topk_impl=self.topk_impl, device=dev,
+            label=f"shard {s}/{len(self._devices)} on {dev}",
+            bands=bands, band_bits=band_bits, probes=self.probes,
+            fallback_density=self.fallback_density,
+        )
+
+    def _lsh_global_keys(self) -> np.ndarray:
+        """Every row's band keys in GLOBAL id order — the
+        layout-fungible durable state (segments translate each shard's
+        local key columns into their global positions)."""
+        out = np.empty((self.band_plan.bands, self.n_codes), np.uint32)
+        for seg in self._segments:
+            ks = self._shards[seg.shard]._buckets.keys
+            out[:, seg.g0 : seg.g0 + seg.rows] = ks[
+                :, seg.l0 : seg.l0 + seg.rows
+            ]
+        return out
+
+    def _durable_extra(self, dirpath: str, gen: int) -> dict:
+        return _spill_lsh_keys(
+            self, dirpath, gen, self._lsh_global_keys()
+        )
+
+    @classmethod
+    def load(cls, path: str, *, mesh=None, devices=None,
+             n_shards: Optional[int] = None, data_axis: str = "data",
+             topk_impl: str = "auto", bands: Optional[int] = None,
+             band_bits: Optional[int] = None,
+             probes: Optional[int] = None,
+             fallback_density: Optional[float] = None):
+        """Restore onto ANY shard layout — LSH-format or pre-LSH
+        snapshots, sharded or plain.  See ``load_lsh_sharded_index``."""
+        return load_lsh_sharded_index(
+            path, mesh=mesh, devices=devices, n_shards=n_shards,
+            data_axis=data_axis, topk_impl=topk_impl, bands=bands,
+            band_bits=band_bits, probes=probes,
+            fallback_density=fallback_density,
+        )
+
+    def query_topk(self, A, m: int, *, tile: int = 2048,
+                   probes: Optional[int] = None):
+        """Top-``m`` across every shard via per-shard candidate
+        generation + exact re-rank + the documented (distance,
+        lower-global-id) cross-shard merge.  Same contract as the base
+        ``query_topk`` (``dist`` int32, ``idx`` int64 global ids,
+        ``m_eff = min(m, n_live)``)."""
+        p = _check_probes(probes, self.probes)
+        if p == 0:
+            return super().query_topk(A, m, tile=tile)
+        if not isinstance(m, numbers.Integral) or m <= 0:
+            raise ValueError(f"m must be a positive int, got {m!r}")
+        A = self._check_queries(A)
+        if self.n_codes == 0:
+            raise ValueError("query_topk on an empty index")
+        if self.n_live == 0:
+            raise ValueError(
+                "query_topk on an index whose codes are all deleted "
+                "(tombstoned); compact() or add() live codes first"
+            )
+        m_eff = int(min(m, self.n_live))
+        # shard 0's mask cache serves the whole tier (shards share one
+        # band plan): the perturbation sequence is pure combinatorics,
+        # not something to recompute per coalesced serving batch
+        masks = self._shards[0]._probe_masks(p)
+        nq = A.shape[0]
+        out_d = np.empty((nq, m_eff), dtype=np.int32)
+        out_i = np.empty((nq, m_eff), dtype=np.int64)
+        pending: list = []  # [(lo, hi, [(si, kind, payload, m_s)])]
+
+        def finish(entry):
+            lo, hi, per_shard = entry
+            d_parts, g_parts = [], []
+            for si, kind, payload, m_s in per_shard:
+                shard = self._shards[si]
+                if kind == "lsh":
+                    d_s, li_s = shard._lsh_finish_tile(payload, m_s)
+                elif kind == "exact":
+                    d_s, li_s = shard._topk_finish_tile(payload, m_s)
+                else:  # 'done'
+                    d_s, li_s = payload
+                d_parts.append(d_s)
+                g_parts.append(self._local_to_global(si, li_s))
+            out_d[lo:hi], out_i[lo:hi] = self._merge_tile(
+                d_parts, g_parts, m_eff
+            )
+
+        for lo in range(0, nq, tile):
+            hi = min(lo + tile, nq)
+            tile_a = A[lo:hi]
+            per_shard = []
+            for si, shard in enumerate(self._shards):
+                if shard.n_live == 0:
+                    continue  # empty or fully-tombstoned shard
+                m_s = int(min(m_eff, shard.n_live))
+                kind, payload = shard._lsh_dispatch_tile(
+                    tile_a, m_s, masks, tile
+                )
+                per_shard.append((si, kind, payload, m_s))
+            telemetry.registry().counter_inc(
+                "shard.dispatches", len(per_shard)
+            )
+            if telemetry.enabled():
+                telemetry.emit(
+                    EVENTS.SHARD_TOPK_TILE, queries=int(hi - lo),
+                    m=int(m_eff), shards=len(per_shard),
+                    n_codes=int(self.n_codes),
+                    **telemetry.trace_fields(),
+                )
+            pending.append((lo, hi, per_shard))
+            if len(pending) >= 2:
+                finish(pending.pop(0))
+        while pending:
+            finish(pending.pop(0))
+        return out_d, out_i
+
+
+# -- durable spill/restore ---------------------------------------------------
+
+
+def _spill_lsh_keys(index, dirpath: str, gen: int,
+                    keys: np.ndarray) -> dict:
+    """THE ``lsh`` manifest block (single source — the single-device
+    and sharded writers differ only in which key view they spill, and
+    ``_resolve_lsh_kwargs``/``_verify_lsh_keys`` read both
+    interchangeably, so the block must never fork): write the keys
+    spill atomically beside the chunks, return the checksummed entry
+    plus the band layout and serving knobs."""
+    from randomprojection_tpu import durable
+
+    fname = f"lsh-{gen:06d}.npy"
+    durable._write_npy_atomic(os.path.join(dirpath, fname), keys)
+    return {"lsh": {
+        "file": fname,
+        "sha256": durable._sha256(keys),
+        "rows": int(keys.shape[1]),
+        "bands": index.band_plan.bands,
+        "band_bits": index.band_plan.band_bits,
+        "probes": index.probes,
+        "fallback_density": index.fallback_density,
+    }}
+
+
+def _resolve_lsh_kwargs(manifest: dict, bands, band_bits, probes,
+                        fallback_density):
+    """Band layout / serving knobs for a restore: explicit kwargs win,
+    the manifest's persisted ``lsh`` block fills the gaps, library
+    defaults fill the rest (the pre-LSH-snapshot path)."""
+    meta = manifest.get("lsh") or {}
+    kw = {
+        "bands": meta.get("bands") if bands is None else int(bands),
+        "band_bits": (
+            meta.get("band_bits") if band_bits is None else int(band_bits)
+        ),
+        "probes": (
+            int(meta.get("probes", 8)) if probes is None else int(probes)
+        ),
+        "fallback_density": (
+            float(meta.get("fallback_density", 0.1))
+            if fallback_density is None
+            else float(fallback_density)
+        ),
+    }
+    return kw, meta
+
+
+def _verify_lsh_keys(dirpath: str, meta: dict, plan: BandPlan,
+                     keys: np.ndarray) -> None:
+    """Cross-check rebuilt band keys against the snapshot's persisted
+    spill: present + same band layout → must match bit-for-bit
+    (checksum verified first), else a loud ``ValueError`` — a corrupt
+    or drifted bucket index must never serve silently-wrong
+    candidates.  Absent (pre-LSH snapshot) or differently-banded
+    (caller override) → the rebuild stands on its own."""
+    if not meta:
+        telemetry.emit(
+            EVENTS.INDEX_LSH_BUILD, path=dirpath, rows=int(keys.shape[1]),
+            n=int(keys.shape[1]), bands=plan.bands,
+            band_bits=plan.band_bits, rebuilt="pre-lsh-snapshot",
+        )
+        return
+    if (
+        meta.get("bands") != plan.bands
+        or meta.get("band_bits") != plan.band_bits
+    ):
+        return  # caller overrode the band layout: persisted keys N/A
+    from randomprojection_tpu import durable
+
+    arr = durable._load_chunk_verified(dirpath, meta)
+    if arr.shape != keys.shape or arr.dtype != np.uint32:
+        raise ValueError(
+            f"persisted LSH band keys in {dirpath} have shape "
+            f"{arr.shape}/{arr.dtype}, expected {keys.shape}/uint32"
+        )
+    if not np.array_equal(arr, keys):
+        raise ValueError(
+            f"persisted LSH band keys in {dirpath} disagree with keys "
+            "rebuilt from the restored codes — the snapshot is corrupt "
+            "or the key extraction drifted; refusing to serve a wrong "
+            "bucket index"
+        )
+
+
+def load_lsh_index(path: str, *, bands: Optional[int] = None,
+                   band_bits: Optional[int] = None,
+                   probes: Optional[int] = None,
+                   fallback_density: Optional[float] = None
+                   ) -> LSHSimHashIndex:
+    """Restore a single-device LSH index from a snapshot directory.
+
+    Accepts LSH-format snapshots (band layout + serving knobs restore
+    from the manifest, persisted keys verified bit-identical against
+    the rebuild) AND pre-LSH r11-format snapshots (the banded index
+    rebuilds from the codes — explicit kwargs or defaults pick the
+    layout).  Chunk checksums, coverage and tombstones verify exactly
+    as ``durable.load_index``."""
+    from randomprojection_tpu import durable
+
+    manifest = durable.read_manifest(path)
+    kw, meta = _resolve_lsh_kwargs(
+        manifest, bands, band_bits, probes, fallback_density
+    )
+    index = durable.load_index(
+        path, index_cls=LSHSimHashIndex, index_kwargs=kw
+    )
+    _verify_lsh_keys(path, meta, index.band_plan, index._buckets.keys)
+    return index
+
+
+def load_lsh_sharded_index(path: str, *, mesh=None, devices=None,
+                           n_shards: Optional[int] = None,
+                           data_axis: str = "data",
+                           topk_impl: str = "auto",
+                           bands: Optional[int] = None,
+                           band_bits: Optional[int] = None,
+                           probes: Optional[int] = None,
+                           fallback_density: Optional[float] = None
+                           ) -> LSHShardedSimHashIndex:
+    """Restore a sharded LSH index onto ANY shard layout (the r13
+    layout-fungibility contract): the corpus re-shards balanced, each
+    shard rebuilds its banded index over its local rows, and the
+    persisted global-id-ordered keys verify against the re-derived
+    global view — so bucket contents are bit-identical whatever layout
+    wrote or reads the snapshot.  Pre-LSH and plain (unsharded)
+    snapshots load with the index rebuilt."""
+    from randomprojection_tpu import durable
+
+    manifest = durable.read_manifest(path)
+    kw, meta = _resolve_lsh_kwargs(
+        manifest, bands, band_bits, probes, fallback_density
+    )
+    index = durable.load_sharded_index(
+        path, mesh=mesh, devices=devices, n_shards=n_shards,
+        data_axis=data_axis, topk_impl=topk_impl,
+        index_cls=LSHShardedSimHashIndex, index_kwargs=kw,
+    )
+    _verify_lsh_keys(
+        path, meta, index.band_plan, index._lsh_global_keys()
+    )
+    return index

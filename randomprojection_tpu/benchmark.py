@@ -41,6 +41,29 @@ TOPK_BENCH_SHAPES = {
                   reqs_per_client=2, max_batch=1024, shards=4, replicas=2),
 }
 
+# multi-probe LSH candidate-tier bench shapes (measure_topk_lsh,
+# ISSUE 15).  The workload is PLANTED neighbors — corpus rows are
+# bit-flip perturbations of cluster centers, queries likewise — i.e.
+# the near-duplicate-retrieval regime the sign-random-projection sketch
+# exists for (uniform random codes have no meaningful neighbors: every
+# distance concentrates at n_bits/2 and "recall" measures noise).
+# ``cluster`` rows per center keeps the true top-``m`` inside the
+# query's cluster, so recall@m is a real retrieval statistic.
+LSH_BENCH_SHAPES = {
+    "full": dict(n_idx=1 << 20, n_bytes=32, cluster=16, nq=256, m=10,
+                 bands=8, band_bits=16, noise_bits=6,
+                 probe_counts=(1, 2, 4, 8, 16), calls=3, rerank_tile=64),
+    "smoke": dict(n_idx=1 << 12, n_bytes=16, cluster=16, nq=48, m=10,
+                  bands=8, band_bits=16, noise_bits=4,
+                  probe_counts=(1, 2), calls=1, rerank_tile=12),
+}
+# recall tripwire (ISSUE 15 acceptance): the committed curve must
+# contain a probe setting reaching this recall@m while re-ranking less
+# than this fraction of the corpus — a bucket bug that tanks recall
+# fails the gate instead of shipping as "fast"
+LSH_RECALL_GATE = 0.95
+LSH_CANDIDATE_FRACTION_GATE = 0.10
+
 PRESETS = {
     # batch rows, scan steps per call, timed calls.  Steps-per-call is high
     # because a dispatch costs ~100-133 ms on the virtualized dev chip
@@ -1055,6 +1078,173 @@ def measure_config4_topk(preset: str = "full") -> dict:
         "dense_d2h_bytes_per_query": 4 * n_idx,
         "checksum": int(last[0][0, 0]) if last is not None else None,
         "sharded": sharded,
+        "lsh": measure_topk_lsh(preset),
+    }
+
+
+def _lsh_flip_bits(rng, codes, flips: int, n_bits: int) -> np.ndarray:
+    """XOR ``flips`` random bit positions into every row (unbuffered —
+    duplicate positions genuinely cancel)."""
+    out = codes.copy()
+    rows = np.repeat(np.arange(out.shape[0], dtype=np.int64), flips)
+    pos = rng.integers(0, n_bits, size=rows.size)
+    np.bitwise_xor.at(
+        out, (rows, pos >> 3),
+        np.left_shift(np.uint8(1), (pos & 7).astype(np.uint8)),
+    )
+    return out
+
+
+def _lsh_exact_reference(queries, codes, m: int, *, q_block: int = 32,
+                         c_block: int = 1 << 16):
+    """Host brute-force reference, blocked over queries AND codes so
+    the full-preset shape (2^20 codes) never materializes a multi-GB
+    distance intermediate."""
+    from randomprojection_tpu.models.sketch import (
+        _host_topk_select,
+        pairwise_hamming,
+    )
+
+    n = codes.shape[0]
+    out_d = np.empty((queries.shape[0], m), np.int32)
+    out_i = np.empty((queries.shape[0], m), np.int32)
+    for lo in range(0, queries.shape[0], q_block):
+        q = queries[lo : lo + q_block]
+        D = np.empty((q.shape[0], n), np.int32)
+        for c0 in range(0, n, c_block):
+            D[:, c0 : c0 + c_block] = pairwise_hamming(
+                q, codes[c0 : c0 + c_block]
+            )
+        d, i = _host_topk_select(D, m)
+        out_d[lo : lo + q_block] = d
+        out_i[lo : lo + q_block] = i
+    return out_d, out_i
+
+
+def _lsh_counters() -> tuple:
+    from randomprojection_tpu.utils import telemetry
+
+    reg = telemetry.registry()
+    return (
+        reg.counter("index.lsh.dispatches"),
+        reg.counter("index.lsh.candidates"),
+        reg.counter("index.lsh.fallbacks"),
+    )
+
+
+def measure_topk_lsh(preset: str = "full") -> dict:
+    """Recall-vs-q/s curve of the multi-probe LSH candidate tier
+    (ISSUE 15): one planted-neighbor corpus (see ``LSH_BENCH_SHAPES``),
+    one exact-serving baseline through the same index (``probes=0`` —
+    the full fused-kernel ladder), then per probe count the EXACT
+    recall@m against host brute force, the candidate fraction actually
+    re-ranked (from the tier's own counters — what was touched, not
+    what was hoped), fallback counts, and queries/s over distinct query
+    slices.  The headline point is the cheapest probe setting clearing
+    BOTH gates (recall ≥ ``LSH_RECALL_GATE``, candidate fraction ≤
+    ``LSH_CANDIDATE_FRACTION_GATE``); ``recall_gate_ok`` is the
+    tripwire — a bucket bug that tanks recall fails the bench instead
+    of shipping as a fast wrong answer."""
+    from randomprojection_tpu.ann import LSHSimHashIndex
+    from randomprojection_tpu.ops import topk_kernels
+
+    shape = LSH_BENCH_SHAPES[preset]
+    n_idx, n_bytes = shape["n_idx"], shape["n_bytes"]
+    cluster, nq, m = shape["cluster"], shape["nq"], shape["m"]
+    noise_bits, calls = shape["noise_bits"], shape["calls"]
+    rerank_tile = shape["rerank_tile"]
+    n_bits = n_bytes * 8
+    rng = np.random.default_rng(15)
+    n_clusters = n_idx // cluster
+    centers = rng.integers(0, 256, size=(n_clusters, n_bytes),
+                           dtype=np.uint8)
+    codes = _lsh_flip_bits(
+        rng, np.repeat(centers, cluster, axis=0), noise_bits, n_bits
+    )
+    # (calls + 1) distinct query sets: set 0 measures recall (and warms
+    # the compile buckets), sets 1..calls are the timed traffic — the
+    # device call cache cannot serve repeats
+    qc = rng.integers(0, n_clusters, size=(calls + 1) * nq)
+    queries = _lsh_flip_bits(rng, centers[qc], noise_bits, n_bits)
+    true_d, true_i = _lsh_exact_reference(queries[:nq], codes, m)
+
+    index = LSHSimHashIndex(
+        codes, bands=shape["bands"], band_bits=shape["band_bits"],
+        fallback_density=1.0,  # the curve measures the tier, not the ladder
+    )
+    # exact-serving baseline through the SAME index (probes=0 pins the
+    # fused/scan ladder): the denominator of speedup_vs_exact
+    index.query_topk(queries[:nq], m, probes=0)  # warm compile
+    t0 = time.perf_counter()
+    for c in range(calls):
+        index.query_topk(
+            queries[(c + 1) * nq : (c + 2) * nq], m, probes=0
+        )
+    exact_qps = calls * nq / (time.perf_counter() - t0)
+
+    curve = []
+    for probes in shape["probe_counts"]:
+        got_d, got_i = index.query_topk(
+            queries[:nq], m, tile=rerank_tile, probes=probes
+        )
+        hits = 0
+        for row_got, row_true in zip(got_i, true_i):
+            hits += np.intersect1d(row_got, row_true).size
+        recall = hits / true_i.size
+        d0, c0, f0 = _lsh_counters()
+        t0 = time.perf_counter()
+        for c in range(calls):
+            index.query_topk(
+                queries[(c + 1) * nq : (c + 2) * nq], m,
+                tile=rerank_tile, probes=probes,
+            )
+        elapsed = time.perf_counter() - t0
+        d1, c1, f1 = _lsh_counters()
+        tiles = d1 - d0
+        frac = (
+            (c1 - c0) / tiles / index.n_live if tiles else None
+        )
+        curve.append({
+            "probes": int(probes),
+            "recall_at_m": round(recall, 4),
+            "candidate_fraction": (
+                round(frac, 6) if frac is not None else None
+            ),
+            "queries_per_s": round(calls * nq / elapsed, 1),
+            "fallbacks": int(f1 - f0),
+            "timing_suspect": bool(topk_kernels.interpret_default()),
+        })
+
+    headline = None
+    for point in curve:
+        if (
+            point["recall_at_m"] >= LSH_RECALL_GATE
+            and point["candidate_fraction"] is not None
+            and point["candidate_fraction"] <= LSH_CANDIDATE_FRACTION_GATE
+        ):
+            headline = dict(point)
+            headline["speedup_vs_exact"] = round(
+                point["queries_per_s"] / exact_qps, 2
+            )
+            break
+    return {
+        "metric": f"lsh recall@{m} vs q/s curve (probe count = knob)",
+        "index_codes": n_idx,
+        "code_bytes": n_bytes,
+        "cluster_rows": cluster,
+        "noise_bits": noise_bits,
+        "queries": nq,
+        "m": m,
+        "bands": shape["bands"],
+        "band_bits": shape["band_bits"],
+        "rerank_tile": rerank_tile,
+        "exact_queries_per_s": round(exact_qps, 1),
+        "topk_interpret": topk_kernels.interpret_default(),
+        "curve": curve,
+        "recall_gate": LSH_RECALL_GATE,
+        "candidate_fraction_gate": LSH_CANDIDATE_FRACTION_GATE,
+        "headline": headline,
+        "recall_gate_ok": headline is not None,
     }
 
 
@@ -1265,6 +1455,17 @@ def bench_rates(record: dict) -> dict:
         if "config4.topk.sharded_queries_per_s" not in rates:
             put("config4.topk.sharded_queries_per_s", c4,
                 "topk_sharded_queries_per_s", "topk_sharded_timing_suspect")
+        # LSH candidate tier (ISSUE 15): the headline curve point's q/s
+        # gates like any serving rate (its own suspect flag — interpret
+        # runs never become a chip baseline)
+        tk2 = c4.get("topk_serving")
+        lsh = tk2.get("lsh") if isinstance(tk2, dict) else None
+        put("config4.topk.lsh_queries_per_s",
+            (lsh or {}).get("headline"), "queries_per_s",
+            "timing_suspect")
+        if "config4.topk.lsh_queries_per_s" not in rates:
+            put("config4.topk.lsh_queries_per_s", c4,
+                "topk_lsh_queries_per_s", "topk_lsh_timing_suspect")
     c5 = record.get("config5")
     put("config5.ingest_tokens_per_s", c5, "ingest_tokens_per_s",
         "ingest_host_suspect")
@@ -1316,12 +1517,40 @@ def compute_regressions(current: dict, previous: dict,
     return out
 
 
+def _lsh_gate_regressions(record: dict) -> list:
+    """The recall tripwire (ISSUE 15): a record whose LSH curve failed
+    the recall/candidate-fraction gate carries the failure as a
+    regression entry — absolute, not baseline-relative, so a bucket
+    bug cannot ship as "fast" even in the very round that introduces
+    it.  Empty when the record has no LSH section or the gate passed."""
+    tk = (record.get("config4") or {}).get("topk_serving") \
+        if isinstance(record.get("config4"), dict) else None
+    lsh = tk.get("lsh") if isinstance(tk, dict) else None
+    if not isinstance(lsh, dict) or lsh.get("recall_gate_ok") is not False:
+        return []
+    best = max(
+        (p.get("recall_at_m") or 0.0 for p in lsh.get("curve") or []),
+        default=0.0,
+    )
+    gate = float(lsh.get("recall_gate", LSH_RECALL_GATE))
+    return [{
+        "metric": "config4.topk.lsh_recall_gate",
+        "previous": gate,
+        "current": round(best, 4),
+        "drop_pct": round(100.0 * max(0.0, 1.0 - best / gate), 1),
+    }]
+
+
 def attach_regressions(record: dict, root: Optional[str] = None) -> dict:
     """Add the ``regressions`` / ``regressions_vs`` keys to a fresh record
     by comparing against the newest committed ``BENCH_r*.json``.  Only a
     full-preset default-shape run is comparable to the committed records;
-    anything else gets an empty list with the skip reason on file."""
-    record.setdefault("regressions", [])
+    anything else gets an empty list with the skip reason on file.  The
+    LSH recall gate (``_lsh_gate_regressions``) rides every path —
+    including skipped comparisons — because it is absolute, not
+    baseline-relative."""
+    gate_regs = _lsh_gate_regressions(record)
+    record["regressions"] = list(gate_regs)
     record.setdefault("regressions_vs", None)
     if record.get("preset") != "full" or record.get("shape_is_default") is False:
         record["regressions_skipped"] = (
@@ -1343,7 +1572,9 @@ def attach_regressions(record: dict, root: Optional[str] = None) -> dict:
             continue
         if not bench_rates(prev):
             continue  # parsed, but nothing comparable in it
-        record["regressions"] = compute_regressions(record, prev)
+        record["regressions"] = gate_regs + compute_regressions(
+            record, prev
+        )
         record["regressions_vs"] = os.path.basename(path)
         record.pop("regressions_skipped", None)
         return record
@@ -1441,6 +1672,27 @@ def compact_summary(record: dict) -> dict:
             c4d["topk_sharded_timing_suspect"] = bool(
                 sh.get("timing_suspect")
             )
+        lsh = tk.get("lsh")
+        if isinstance(lsh, dict):
+            # LSH-tier digest (ISSUE 15): the headline point + the
+            # recall tripwire verdict, flat so a compact-line-only
+            # round still gates recall and the rate
+            c4d["topk_lsh_recall_gate_ok"] = bool(
+                lsh.get("recall_gate_ok")
+            )
+            hl = lsh.get("headline")
+            if isinstance(hl, dict):
+                c4d["topk_lsh_probes"] = hl.get("probes")
+                c4d["topk_lsh_recall"] = _sig(hl.get("recall_at_m"), 3)
+                c4d["topk_lsh_candidate_fraction"] = _sig(
+                    hl.get("candidate_fraction"), 3
+                )
+                c4d["topk_lsh_queries_per_s"] = _sig(
+                    hl.get("queries_per_s")
+                )
+                c4d["topk_lsh_timing_suspect"] = bool(
+                    hl.get("timing_suspect")
+                )
     regs = record.get("regressions", [])
     if len(regs) > 8:
         c["regressions_truncated"] = len(regs) - 8

@@ -371,6 +371,16 @@ def build_parser():
                    help="replica groups for the sharded tier; coalesced "
                         "batches route round-robin across them "
                         "(serving.ShardedTopKServer)")
+    q.add_argument("--probes", default="", metavar="P1,P2,...",
+                   help="also measure the multi-probe LSH candidate "
+                        "tier (ann.LSHSimHashIndex) at each probe "
+                        "count: recall@m vs brute force, candidate "
+                        "fraction and q/s per point — the recall/q-s "
+                        "tradeoff curve (empty = skip)")
+    q.add_argument("--lsh-bands", type=int, default=0,
+                   help="LSH band count (0 = auto: min(8, bits/band))")
+    q.add_argument("--lsh-band-bits", type=int, default=0,
+                   help="LSH bits per band key (0 = auto: min(16, bits))")
     q.add_argument("--seed", type=int, default=0)
     _add_observability(q)
 
@@ -405,6 +415,12 @@ def build_parser():
     q.add_argument("--topk-impl", default="auto",
                    choices=["auto", "fused", "scan"],
                    help="query_topk device path per shard")
+    q.add_argument("--probes", type=int, default=0, metavar="P",
+                   help="serve through the multi-probe LSH candidate "
+                        "tier (ann.LSHShardedSimHashIndex) probing P "
+                        "buckets per band — the recall/latency knob the "
+                        "per-label SLO record expresses (0 = exact "
+                        "scan tier)")
     q.add_argument("--rate", type=float, default=50.0, metavar="QPS",
                    help="mean offered request rate (requests/s)")
     q.add_argument("--duration", type=float, default=5.0, metavar="SEC",
@@ -1000,6 +1016,61 @@ def cmd_topk_bench(args):
             "replica_batches": sh_stats["replica_batches"],
         }
 
+    lsh = None
+    if args.probes.strip():
+        from randomprojection_tpu.ann import LSHSimHashIndex
+        from randomprojection_tpu.models.sketch import topk_bruteforce
+
+        try:
+            probe_counts = [
+                int(v) for v in args.probes.split(",") if v.strip()
+            ]
+        except ValueError:
+            probe_counts = []
+        if not probe_counts or any(p < 1 for p in probe_counts):
+            raise SystemExit(
+                f"--probes wants a comma list of positive ints, got "
+                f"{args.probes!r}"
+            )
+        lsh_index = LSHSimHashIndex(
+            codes,
+            bands=args.lsh_bands or None,
+            band_bits=args.lsh_band_bits or None,
+            topk_impl=args.topk_impl,
+        )
+        # exact truth for recall@m: brute force over the same corpus
+        # (host reference — the documented tie order)
+        ref_rows = min(len(requests), 4) * args.request_rows
+        true_d, true_i = topk_bruteforce(pool[:ref_rows], codes, args.m)
+        # warm the re-rank compile buckets before any timed loop
+        lsh_index.query_topk(pool[:ref_rows], args.m,
+                             probes=probe_counts[0])
+        lsh_curve = []
+        for p in probe_counts:
+            gd, gi = lsh_index.query_topk(pool[:ref_rows], args.m,
+                                          probes=p)
+            hits = 0
+            for row_got, row_true in zip(gi, true_i):
+                hits += np.intersect1d(row_got, row_true).size
+            t0 = time.perf_counter()
+            for req in requests:
+                lsh_index.query_topk(req, args.m, probes=p)
+            elapsed = time.perf_counter() - t0
+            lsh_curve.append({
+                "probes": p,
+                "recall_at_m": round(hits / true_i.size, 4),
+                "queries_per_s": round(
+                    len(requests) * args.request_rows / elapsed, 1
+                ),
+            })
+        lsh = {
+            "bands": lsh_index.band_plan.bands,
+            "band_bits": lsh_index.band_plan.band_bits,
+            "fallback_density": lsh_index.fallback_density,
+            "curve": lsh_curve,
+            **{f"lsh_{k}": v for k, v in lsh_index.lsh_stats().items()},
+        }
+
     print(json.dumps({
         "metric": f"simhash top-k serving queries/s (m={args.m}, "
                   f"{args.index_codes} codes)",
@@ -1020,6 +1091,7 @@ def cmd_topk_bench(args):
         "server_delay_ms": args.server_delay_ms,
         **{f"server_{k}": v for k, v in server.stats().items()},
         **({"sharded": sharded} if sharded else {}),
+        **({"lsh": lsh} if lsh else {}),
     }))
     _write_openmetrics(args)
 
@@ -1065,12 +1137,25 @@ def cmd_loadgen(args):
     codes = rng.integers(
         0, 256, size=(args.index_codes, args.code_bytes), dtype=np.uint8
     )
-    groups = [
-        ShardedSimHashIndex(
-            codes, n_shards=args.shards, topk_impl=args.topk_impl
-        )
-        for _ in range(args.replicas)
-    ]
+    if args.probes > 0:
+        # the LSH candidate tier serves: probes is the recall/latency
+        # knob the per-label SLO tables then express (ISSUE 15)
+        from randomprojection_tpu.ann import LSHShardedSimHashIndex
+
+        groups = [
+            LSHShardedSimHashIndex(
+                codes, n_shards=args.shards, topk_impl=args.topk_impl,
+                probes=args.probes,
+            )
+            for _ in range(args.replicas)
+        ]
+    else:
+        groups = [
+            ShardedSimHashIndex(
+                codes, n_shards=args.shards, topk_impl=args.topk_impl
+            )
+            for _ in range(args.replicas)
+        ]
     server = ShardedTopKServer(
         groups, args.m, max_batch=args.server_batch,
         max_delay_s=args.server_delay_ms / 1e3,
@@ -1094,6 +1179,7 @@ def cmd_loadgen(args):
         "m": args.m,
         "shards": args.shards,
         "replicas": args.replicas,
+        "probes": args.probes,
     })
     if args.out:
         with open(args.out, "w") as f:

@@ -153,7 +153,7 @@ def _next_generation_from_files(dirpath: str) -> int:
 
     gen = -1
     for fn in os.listdir(dirpath):
-        m = re.match(r"(?:chunk|tombstones)-(\d{6})", fn)
+        m = re.match(r"(?:chunk|tombstones|lsh)-(\d{6})", fn)
         if m:
             gen = max(gen, int(m.group(1)))
     return gen + 1
@@ -235,6 +235,8 @@ def _referenced_files(manifest: dict) -> set:
     refs = {e["file"] for e in manifest["chunks"]}
     if manifest.get("tombstones"):
         refs.add(manifest["tombstones"]["file"])
+    if manifest.get("lsh"):
+        refs.add(manifest["lsh"]["file"])
     return refs
 
 
@@ -247,7 +249,11 @@ def _scan_orphans(dirpath: str, manifest: Optional[dict]) -> list:
     for fn in sorted(os.listdir(dirpath)):
         if fn.endswith(".tmp") or (
             fn.endswith(".npy")
-            and (fn.startswith("chunk-") or fn.startswith("tombstones-"))
+            and (
+                fn.startswith("chunk-")
+                or fn.startswith("tombstones-")
+                or fn.startswith("lsh-")
+            )
             and fn not in refs
         ):
             orphans.append(fn)
@@ -338,6 +344,13 @@ def save_index(index, dirpath: str, *, ingest: Optional[dict] = None) -> dict:
     }
     if ingest is not None:
         manifest["ingest"] = ingest
+    # index-class extras (the ann LSH tier spills its band keys beside
+    # the chunks and records them here): spilled BEFORE the manifest
+    # commit, so the atomicity argument is unchanged — a crash leaves
+    # the extra file as an orphan the next sweep collects
+    extra_hook = getattr(index, "_durable_extra", None)
+    if extra_hook is not None:
+        manifest.update(extra_hook(dirpath, gen))
     _commit_manifest(dirpath, manifest)
     # the new snapshot is committed: the previous generation's files are
     # now unreferenced debris (a crash before this sweep just leaves
@@ -353,7 +366,8 @@ def save_index(index, dirpath: str, *, ingest: Optional[dict] = None) -> dict:
     return manifest
 
 
-def load_index(dirpath: str, *, mesh=None, data_axis: str = "data"):
+def load_index(dirpath: str, *, mesh=None, data_axis: str = "data",
+               index_cls=None, index_kwargs: Optional[dict] = None):
     """Rebuild a ``SimHashIndex`` from a snapshot directory.
 
     The manifest's format version is checked first, every chunk payload
@@ -364,6 +378,10 @@ def load_index(dirpath: str, *, mesh=None, data_axis: str = "data"):
     format is mesh-agnostic, and a snapshot written by the SHARDED tier
     (``save_sharded_index`` spills in global id order) loads here as a
     plain single-device index with identical query results.
+
+    ``index_cls``/``index_kwargs`` restore a subclass instead (the ann
+    LSH tier — its append hook rebuilds derived structures as the
+    chunks re-add); ``ann.load_lsh_index`` is the public face.
     """
     from randomprojection_tpu.models.sketch import SimHashIndex
 
@@ -379,9 +397,12 @@ def load_index(dirpath: str, *, mesh=None, data_axis: str = "data"):
             "load it with ShardedSimHashIndex.load / "
             "durable.load_sharded_index, which restores the offset"
         )
-    index = SimHashIndex(
+    cls = SimHashIndex if index_cls is None else index_cls
+    kw = dict(index_kwargs or {})
+    kw.update(mesh=mesh, data_axis=data_axis)
+    index = cls(
         np.empty((0, manifest["n_bytes"]), np.uint8),
-        n_bits=manifest["n_bits"], mesh=mesh, data_axis=data_axis,
+        n_bits=manifest["n_bits"], **kw,
     )
     for entry in manifest["chunks"]:
         arr = _load_chunk_verified(dirpath, entry)
@@ -478,6 +499,11 @@ def save_sharded_index(index, dirpath: str) -> dict:
     }
     if index.id_offset:
         manifest["id_offset"] = int(index.id_offset)
+    # index-class extras (see save_index): the sharded LSH tier spills
+    # its band keys in GLOBAL id order, layout-fungible like the chunks
+    extra_hook = getattr(index, "_durable_extra", None)
+    if extra_hook is not None:
+        manifest.update(extra_hook(dirpath, gen))
     check_coverage(manifest)  # the writer holds itself to the invariant
     _commit_manifest(dirpath, manifest)
     for fn in _scan_orphans(dirpath, manifest):
@@ -492,7 +518,8 @@ def save_sharded_index(index, dirpath: str) -> dict:
 
 def load_sharded_index(dirpath: str, *, mesh=None, devices=None,
                        n_shards=None, data_axis: str = "data",
-                       topk_impl: str = "auto"):
+                       topk_impl: str = "auto", index_cls=None,
+                       index_kwargs: Optional[dict] = None):
     """Rebuild a ``serving.ShardedSimHashIndex`` from a snapshot
     directory onto ANY shard layout (``mesh`` / ``devices`` /
     ``n_shards`` — resolution as in ``serving.shard_devices``).  Works
@@ -527,10 +554,12 @@ def load_sharded_index(dirpath: str, *, mesh=None, devices=None,
             f"{manifest['n_codes']}"
         )
     id_offset = int(manifest.get("id_offset", 0))
-    index = ShardedSimHashIndex(
+    cls = ShardedSimHashIndex if index_cls is None else index_cls
+    index = cls(
         codes, mesh=mesh, devices=devices, n_shards=n_shards,
         data_axis=data_axis, n_bits=manifest["n_bits"],
         topk_impl=topk_impl, id_offset=id_offset,
+        **(index_kwargs or {}),
     )
     tomb = manifest.get("tombstones")
     if tomb:
@@ -594,11 +623,24 @@ def _verify_manifest(dirpath: str, manifest: dict, status: dict) -> dict:
         "rows_done": (manifest.get("ingest") or {}).get("rows_done"),
         "sharded": (manifest.get("sharded") or {}).get("shards"),
         "id_offset": manifest.get("id_offset", 0),
+        "lsh": (
+            {
+                "bands": manifest["lsh"].get("bands"),
+                "band_bits": manifest["lsh"].get("band_bits"),
+            }
+            if manifest.get("lsh")
+            else None
+        ),
     })
     corrupt = []
     entries = list(manifest["chunks"])
     if manifest.get("tombstones"):
         entries.append(manifest["tombstones"])
+    if manifest.get("lsh"):
+        # the banded-index key spill verifies like any chunk (it is
+        # rebuildable from the codes, but serving a corrupt one silently
+        # is exactly what `cli recover` exists to catch)
+        entries.append(manifest["lsh"])
     for entry in entries:
         try:
             _load_chunk_verified(dirpath, entry)

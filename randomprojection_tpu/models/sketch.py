@@ -362,6 +362,15 @@ class SimHashIndex:
         if codes.shape[0]:
             self._upload_chunk(codes)
 
+    def _codes_appended(self, codes: np.ndarray, row0: int) -> None:
+        """Subclass hook: host ``codes`` just became global rows
+        ``[row0, row0 + len(codes))`` of this index (every append path —
+        construction, ``add``, snapshot restore, compaction re-upload —
+        funnels through ``_upload_chunk`` and lands here).  The
+        multi-probe LSH tier (``ann.LSHSimHashIndex``) folds the new
+        rows into its banded bucket index from this hook; the base
+        index keeps no derived structures."""
+
     def _upload_chunk(self, codes):
         import jax
         import jax.numpy as jnp
@@ -410,7 +419,11 @@ class SimHashIndex:
             self._dead = np.concatenate(
                 [self._dead, np.zeros(n, dtype=bool)]
             )
+        row0 = self.n_codes
         self.n_codes += n
+        # codes[:n] is the pre-pad host view: mesh padding above never
+        # reaches derived structures (pad rows have no global id)
+        self._codes_appended(codes[:n], row0)
 
     def add(self, codes):
         """Append codes as a new resident chunk — ships only the new rows."""

@@ -163,11 +163,7 @@ class ShardedSimHashIndex:
         self.data_axis = data_axis
         self._devices = shard_devices(mesh, devices, n_shards, data_axis)
         self._shards = [
-            SimHashIndex(
-                np.empty((0, self.n_bytes), np.uint8),
-                n_bits=self.n_bits, topk_impl=topk_impl, device=dev,
-                label=f"shard {s}/{len(self._devices)} on {dev}",
-            )
+            self._make_shard(s, dev)
             for s, dev in enumerate(self._devices)
         ]
         self._segments: list = []
@@ -180,6 +176,18 @@ class ShardedSimHashIndex:
         self._merge_stats_lock = threading.Lock()
         if codes.shape[0]:
             self.add(codes)
+
+    def _make_shard(self, s: int, dev) -> SimHashIndex:
+        """One empty per-device shard — the single construction point
+        (``__init__`` and ``compact()``'s re-balance both come through
+        here), and the serving hook the multi-probe LSH tier overrides:
+        ``ann.LSHShardedSimHashIndex`` returns shards that carry their
+        own banded bucket indexes, everything else identical."""
+        return SimHashIndex(
+            np.empty((0, self.n_bytes), np.uint8),
+            n_bits=self.n_bits, topk_impl=self.topk_impl, device=dev,
+            label=f"shard {s}/{len(self._devices)} on {dev}",
+        )
 
     # -- shape/accounting ----------------------------------------------------
 
@@ -412,11 +420,7 @@ class ShardedSimHashIndex:
         old_n = self.n_codes
         chunks_before = sum(len(s._chunks) for s in self._shards)
         self._shards = [
-            SimHashIndex(
-                np.empty((0, self.n_bytes), np.uint8),
-                n_bits=self.n_bits, topk_impl=self.topk_impl, device=dev,
-                label=f"shard {s}/{len(self._devices)} on {dev}",
-            )
+            self._make_shard(s, dev)
             for s, dev in enumerate(self._devices)
         ]
         self._segments = []
@@ -485,6 +489,45 @@ class ShardedSimHashIndex:
 
     # -- the serving path ----------------------------------------------------
 
+    def _merge_tile(self, d_parts: list, g_parts: list, m_eff: int):
+        """THE cross-shard candidate merge: concatenate per-shard
+        ``(dist, 0-based global id)`` candidate columns and select the
+        top ``m_eff`` per row under the exact (row, distance,
+        lower-global-id) order via one stable ``np.lexsort`` — immune
+        to key-packing overflow however wide the int64 id space is.
+        Returns ``(dist, idx)`` with ``idx`` already ``id_offset``
+        -shifted.  Shared by the exact fan-out path and the multi-probe
+        LSH tier (``ann.LSHShardedSimHashIndex``), so the documented
+        merge order cannot drift between them; also owns the merge
+        tallies and the ``shard.merge`` telemetry."""
+        t0 = time.perf_counter()
+        D = np.concatenate(d_parts, axis=1)
+        G = np.concatenate(g_parts, axis=1)
+        t, k = D.shape
+        order = np.lexsort(
+            (G.ravel(), D.ravel(), np.repeat(np.arange(t), k))
+        )
+        sel = order.reshape(t, k)[:, :m_eff]
+        out_d = D.ravel()[sel]
+        out_i = G.ravel()[sel] + self.id_offset
+        wall = time.perf_counter() - t0
+        with self._merge_stats_lock:
+            self._merges += 1
+            self._merge_wall_s += wall
+        # live plane (r17): the per-merge wall as a registry gauge
+        # (last/mean/max) so a scrape sees cross-shard merge cost
+        # without replaying the event log
+        telemetry.registry().gauge_set(
+            "serve.shard.merge_wall_s", wall
+        )
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.SHARD_MERGE, queries=int(t), candidates=int(k),
+                shards=len(d_parts), m=int(m_eff),
+                wall_s=round(wall, 6), **telemetry.trace_fields(),
+            )
+        return out_d, out_i
+
     def query_topk(self, A, m: int, *, tile: int = 2048):
         """Top-``m`` nearest codes per query across every shard.
 
@@ -530,35 +573,9 @@ class ShardedSimHashIndex:
                     d_s, li_s = payload
                 d_parts.append(d_s)
                 g_parts.append(self._local_to_global(si, li_s))
-            t0 = time.perf_counter()
-            D = np.concatenate(d_parts, axis=1)
-            G = np.concatenate(g_parts, axis=1)
-            t, k = D.shape
-            # exact (row, distance, lower-global-id) order via lexsort:
-            # stable, and immune to key-packing overflow however wide
-            # the int64 id space is
-            order = np.lexsort(
-                (G.ravel(), D.ravel(), np.repeat(np.arange(t), k))
+            out_d[lo:hi], out_i[lo:hi] = self._merge_tile(
+                d_parts, g_parts, m_eff
             )
-            sel = order.reshape(t, k)[:, :m_eff]
-            out_d[lo:hi] = D.ravel()[sel]
-            out_i[lo:hi] = G.ravel()[sel] + self.id_offset
-            wall = time.perf_counter() - t0
-            with self._merge_stats_lock:
-                self._merges += 1
-                self._merge_wall_s += wall
-            # live plane (r17): the per-merge wall as a registry gauge
-            # (last/mean/max) so a scrape sees cross-shard merge cost
-            # without replaying the event log
-            telemetry.registry().gauge_set(
-                "serve.shard.merge_wall_s", wall
-            )
-            if telemetry.enabled():
-                telemetry.emit(
-                    EVENTS.SHARD_MERGE, queries=int(t), candidates=int(k),
-                    shards=len(per_shard), m=int(m_eff),
-                    wall_s=round(wall, 6), **telemetry.trace_fields(),
-                )
 
         for lo in range(0, nq, tile):
             hi = min(lo + tile, nq)

@@ -226,6 +226,15 @@ class EVENTS:
     TELEMETRY_SUBSCRIBER_DROPPED = "telemetry.subscriber.dropped"
     SERVE_LATENCY_REQUEST = "serve.latency.request"
     LOADGEN_RUN = "loadgen.run"
+    # multi-probe LSH candidate tier (ISSUE 15): per-tile candidate
+    # generation record (probes, candidate fraction), the density/
+    # starvation fallback to the exact-scan ladder rung (degraded-to-
+    # exact — on the doctor's audit), and banded-bucket build folds.
+    # Deliberately NOT a family — rogue ``index.lsh.*`` names stay
+    # lintable (rp02_lsh_bad.py).
+    INDEX_LSH_DISPATCH = "index.lsh.dispatch"
+    INDEX_LSH_FALLBACK = "index.lsh.fallback"
+    INDEX_LSH_BUILD = "index.lsh.build"
 
     # runtime-completed name families.  ``*_FAMILY`` constants are the
     # prefixes callers build on (today: the per-kernel-path hash counter

@@ -69,6 +69,10 @@ DEGRADED_EVENTS = (
     # live plane (r17): a subscriber overflowing its bounded queue means
     # the live view lost events — degraded observability, on the audit
     EVENTS.TELEMETRY_SUBSCRIBER_DROPPED,
+    # LSH candidate tier (ISSUE 15): a tile whose candidate set was too
+    # dense/starved served through the exact scan instead — correct but
+    # sublinear no more, so the fallback rate belongs on the audit
+    EVENTS.INDEX_LSH_FALLBACK,
 )
 
 
@@ -167,6 +171,16 @@ def build_report(path: str) -> dict:
     # extracted at the end by the shared bucket math
     lat_hists: dict = {}
     loadgen_runs: list = []
+    # LSH candidate tier (ISSUE 15): per-tile candidate generation,
+    # fallback reasons, bucket-build folds
+    lsh_tiles = 0
+    lsh_queries = 0
+    lsh_probes = 0
+    lsh_candidates = 0
+    lsh_frac_sum = 0.0
+    lsh_fallbacks: dict = {}
+    lsh_builds = 0
+    lsh_build_rows = 0
 
     def _lat_observe(key: str, seconds: float) -> None:
         h = lat_hists.setdefault(key, {"sum": 0.0, "count": 0,
@@ -288,6 +302,27 @@ def build_report(path: str) -> dict:
                 _lat_observe(server, total)
                 if e.get("label") is not None:
                     _lat_observe(f"{server}[{e['label']}]", total)
+        elif name == EVENTS.INDEX_LSH_DISPATCH:
+            # one LSH-served query tile: how many buckets were probed
+            # and what fraction of the corpus the re-rank touched — the
+            # doctor's view of whether retrieval is actually sublinear.
+            # Bucket lookups = queries x bands x probes, matching the
+            # index.lsh.probe_buckets registry counter exactly
+            lsh_tiles += 1
+            lsh_queries += e.get("queries", 0) or 0
+            lsh_probes += (
+                (e.get("queries", 0) or 0)
+                * (e.get("probes", 0) or 0)
+                * (e.get("bands", 0) or 0)
+            )
+            lsh_candidates += e.get("candidates", 0) or 0
+            lsh_frac_sum += e.get("candidate_fraction", 0.0) or 0.0
+        elif name == EVENTS.INDEX_LSH_FALLBACK:
+            reason = str(e.get("reason") or "unknown")
+            lsh_fallbacks[reason] = lsh_fallbacks.get(reason, 0) + 1
+        elif name == EVENTS.INDEX_LSH_BUILD:
+            lsh_builds += 1
+            lsh_build_rows += e.get("rows", 0) or 0
         elif name == EVENTS.LOADGEN_RUN:
             loadgen_runs.append({
                 "requests": e.get("requests"),
@@ -411,6 +446,34 @@ def build_report(path: str) -> dict:
             if (topk_dispatches or shard_tiles or shard_batches)
             else None
         ),
+        "candidate_generation": (
+            {
+                "lsh_tiles": lsh_tiles,
+                "lsh_queries": lsh_queries,
+                "probed_buckets_per_tile": (
+                    round(lsh_probes / lsh_tiles, 2) if lsh_tiles else 0.0
+                ),
+                "candidates": lsh_candidates,
+                "candidate_fraction_mean": (
+                    round(lsh_frac_sum / lsh_tiles, 6) if lsh_tiles
+                    else None
+                ),
+                "fallbacks": dict(sorted(lsh_fallbacks.items())),
+                "fallback_rate": (
+                    round(
+                        sum(lsh_fallbacks.values())
+                        / (lsh_tiles + sum(lsh_fallbacks.values())),
+                        4,
+                    )
+                    if (lsh_tiles or lsh_fallbacks)
+                    else None
+                ),
+                "builds": lsh_builds,
+                "build_rows": lsh_build_rows,
+            }
+            if (lsh_tiles or lsh_fallbacks or lsh_builds)
+            else None
+        ),
         "latency": (
             {
                 key: quantiles_from_buckets(
@@ -527,6 +590,37 @@ def render_report(report: dict) -> str:
                 f"  replica routing: {sv['shard_batches']} coalesced "
                 f"batch(es), {sv['shard_batch_rows']} rows over "
                 f"{len(reps)} replica(s)"
+            )
+    cg = report.get("candidate_generation")
+    if cg:
+        lines.append("")
+        lines.append("candidate generation (multi-probe LSH):")
+        frac = cg.get("candidate_fraction_mean")
+        lines.append(
+            f"  {cg['lsh_tiles']} LSH tile(s), {cg['lsh_queries']} query "
+            f"rows, mean {cg['probed_buckets_per_tile']} probed "
+            f"buckets/tile"
+        )
+        lines.append(
+            f"  candidates re-ranked: {cg['candidates']}"
+            + (
+                f" (mean {100.0 * frac:.2f}% of the live corpus per tile)"
+                if frac is not None else ""
+            )
+        )
+        fb = cg.get("fallbacks") or {}
+        if fb:
+            detail = ", ".join(f"{k} {v}" for k, v in fb.items())
+            lines.append(
+                f"  fallbacks to the exact path: {sum(fb.values())} "
+                f"({detail}; rate {cg['fallback_rate']})"
+            )
+        else:
+            lines.append("  fallbacks to the exact path: none")
+        if cg.get("builds"):
+            lines.append(
+                f"  bucket builds: {cg['builds']} fold(s), "
+                f"{cg['build_rows']} rows"
             )
     lat = report.get("latency")
     if lat:

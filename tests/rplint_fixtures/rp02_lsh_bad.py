@@ -1,0 +1,19 @@
+"""RP02 fixture (ISSUE 15 satellite): an LSH candidate-tier path
+emitting an ``index.lsh.*`` event name that is NOT in
+``telemetry.EVENTS``.  Linted against the REAL registry — the
+``index.lsh`` namespace deliberately has NO family prefix, so every
+candidate-tier event must be individually registered (a family would
+wave rogue names through, and the doctor's candidate-generation
+section would silently miss them)."""
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+
+def probe_with_unregistered_event(queries, candidates):
+    # VIOLATION: a candidate-tier event dodging the registry —
+    # invisible to the doctor's candidate-generation section
+    telemetry.emit("index.lsh.rogue_probe", queries=queries, n=candidates)
+    # ok: the registered per-tile candidate-generation record
+    telemetry.emit(
+        EVENTS.INDEX_LSH_DISPATCH, queries=queries, candidates=candidates
+    )

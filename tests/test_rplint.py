@@ -447,6 +447,29 @@ def test_rp02_unregistered_shard_event_fixture():
     assert not suppressed
 
 
+def test_rp02_unregistered_lsh_event_fixture():
+    """ISSUE 15 satellite: an unregistered ``index.lsh.*`` emit is
+    caught against the REAL shipped registry — the candidate-tier
+    namespace has no family prefix, so each event must be individually
+    registered, and the registered dispatch event in the same fixture
+    stays clean."""
+    real = rplint.load_event_registry(
+        open(os.path.join(
+            rplint.package_root(), "utils", "telemetry.py"
+        )).read()
+    )
+    assert real is not None and real.knows("index.lsh.dispatch")
+    assert real.knows("index.lsh.fallback")
+    assert real.knows("index.lsh.build")
+    assert not real.knows("index.lsh.rogue_probe")
+    active, suppressed = _split(
+        _lint_fixture("rp02_lsh_bad.py", registry=real)
+    )
+    assert [f.rule for f in active] == ["RP02"]
+    assert "'index.lsh.rogue_probe'" in active[0].message
+    assert not suppressed
+
+
 # -- ISSUE 11: flow-sensitive rules (RP07-RP09) ------------------------------
 
 

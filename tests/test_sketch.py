@@ -639,6 +639,14 @@ def test_topk_bench_composition(monkeypatch):
         dict(n_idx=2048, q_tile=128, clients=2, req_rows=16,
              reqs_per_client=2, max_batch=64, shards=2, replicas=2),
     )
+    # the LSH leg (ISSUE 15) rides the same bench: patch its shape to
+    # toy sizes too (the gate-level assertions live in test_ann.py)
+    monkeypatch.setitem(
+        benchmark.LSH_BENCH_SHAPES, "smoke",
+        dict(n_idx=512, n_bytes=8, cluster=8, nq=8, m=5, bands=4,
+             band_bits=8, noise_bits=2, probe_counts=(1,), calls=1,
+             rerank_tile=8),
+    )
     tk = benchmark.measure_config4_topk("smoke")
     assert tk["queries_per_s"] > 0
     assert tk["single_stream_queries_per_s"] > 0
