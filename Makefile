@@ -46,7 +46,10 @@
 #                      interpreter: full-probe coverage == exact ==
 #                      brute force (single-device + 8-shard, cross-shard
 #                      tombstones), the density-fallback rung exact, and
-#                      partial-probe distances true Hamming
+#                      partial-probe distances true Hamming; plus the
+#                      device-fused probe path (ISSUE 16) bit-identical
+#                      to the host path (multi-chunk, tombstones,
+#                      ragged n_bits, 8-shard) via the same interpreter
 #   make recover-smoke subprocess kill/resume harness at toy shapes:
 #                      SIGKILL the durable ingest at every injected
 #                      point, restart, assert the recovered index is

@@ -84,6 +84,7 @@ def main():
     from randomprojection_tpu.ops import (
         hashing,
         pallas_kernels,
+        probe_kernels,
         split_matmul,
         topk_kernels,
     )
@@ -106,6 +107,7 @@ def main():
         ("`randomprojection_tpu.ops.hashing`", hashing),
         ("`randomprojection_tpu.ops.pallas_kernels`", pallas_kernels),
         ("`randomprojection_tpu.ops.topk_kernels`", topk_kernels),
+        ("`randomprojection_tpu.ops.probe_kernels`", probe_kernels),
         ("`randomprojection_tpu.ops.split_matmul`", split_matmul),
         ("`randomprojection_tpu.utils.observability`", observability),
         ("`randomprojection_tpu.utils.telemetry`", telemetry),

@@ -209,6 +209,9 @@ HOT_MODULES = (
     # serving hot loop — a hidden host sync there re-serializes exactly
     # the dispatch/d2h overlap the tier inherits from query_topk
     "ann/lsh.py",
+    # r19 device-fused probe path: the probe→gather→re-rank kernels run
+    # per serving tile — a host sync here IS the host hop they remove
+    "ops/probe_kernels.py",
 )
 # RP06: modules on the pipeline/serving path where a swallowed error
 # strands a stream, a future, or a telemetry file
@@ -229,6 +232,7 @@ DETERMINISM_PREFIXES = ("ops/",)
 KERNEL_BUDGET_FNS = {
     "ops/pallas_kernels.py": "_reserved_bytes",
     "ops/topk_kernels.py": "plan_fused",
+    "ops/probe_kernels.py": "plan_probe",
 }
 KERNEL_MODULES = tuple(KERNEL_BUDGET_FNS)
 # RP10/RP11 (ISSUE 12): the modules where threads and locks meet — the

@@ -70,10 +70,30 @@ restored codes — corruption or extraction drift is a loud
 ``ValueError``, never a silently-wrong bucket index.  A pre-LSH
 (r11-format) snapshot loads cleanly with the index rebuilt from codes.
 
+**Device-fused candidate generation (ISSUE 16)** — the probe half
+above runs on the host; at production q/s that hop is the serving
+floor.  ``probe_path='device'`` (or ``'auto'`` on a real accelerator)
+mirrors the banded CSR onto the device (``_lsh_device_csr``, revision-
+clocked against every bucket mutation) and serves each tile through
+``ops.probe_kernels.device_probe_topk`` — band keys, probe walks,
+sort-unique dedup, tombstone masking, chunk gather and the r12 fused
+re-rank in ONE dispatch, the only per-tile host bytes being the query
+upload.  A post-hoc ladder (the stats plane read at finish time)
+degrades overflowing / starved / too-dense tiles to the exact path,
+and shapes the probe planner cannot tile serve the host rung
+(``device_plan``, memoized).  ``adaptive=True`` escalates probes
+per query in popcount rounds with an early-exit distance bound and an
+optional ``candidate_budget`` (see ``_lsh_adaptive_tile`` — safe by
+construction, recall monotone in the budget).  At full probe coverage
+the device path remains bit-identical to host probing and to brute
+force (``make ann-smoke``'s device-parity leg).
+
 Telemetry: ``index.lsh.dispatch`` (probe counts, candidate fraction),
 ``index.lsh.fallback`` (reason — the doctor's degraded audit),
-``index.lsh.build`` (bucket folds) — all in ``telemetry.EVENTS`` and
-consumed by ``trace_report``'s candidate-generation section.
+``index.lsh.build`` (bucket folds), plus the device tier's
+``index.lsh.device_dispatch`` / ``index.lsh.device_upload`` /
+``index.lsh.adaptive`` — all in ``telemetry.EVENTS`` and consumed by
+``trace_report``'s candidate-generation section.
 """
 
 from __future__ import annotations
@@ -81,6 +101,7 @@ from __future__ import annotations
 import itertools
 import numbers
 import os
+import time
 from typing import Optional
 
 import numpy as np
@@ -113,6 +134,49 @@ _MAX_BAND_BITS = 20
 # band-key extraction block: bounds the unpacked bit matrix to
 # ~2 MB/256-bit codes however large one add() is
 _KEY_EXTRACT_BLOCK = 1 << 16
+# device-CSR id pad: one maximal DMA block of sentinel ids per band, so
+# a ragged last run block can overread past ``end`` without clamping
+# (covers every ``blk`` ops/probe_kernels.plan_probe can pick)
+_LSH_IDS_PAD = 512
+_INT32_MAX = np.int32(2**31 - 1)
+
+_PROBE_PATHS = ("auto", "host", "device")
+
+
+def _check_probe_path(probe_path) -> str:
+    if probe_path not in _PROBE_PATHS:
+        raise ValueError(
+            f"probe_path must be one of {_PROBE_PATHS}, got {probe_path!r}"
+        )
+    return str(probe_path)
+
+
+def _check_ctor_probes(probes) -> int:
+    """Constructor ``probes`` validation: a strictly positive real int.
+    ``bool`` is ``numbers.Integral``, so it is rejected explicitly — a
+    ``probes=True`` caller almost certainly meant a count, and silently
+    probing once would be a recall cliff."""
+    if (isinstance(probes, bool) or not isinstance(probes, numbers.Integral)
+            or probes < 1):
+        raise ValueError(
+            f"probes must be a positive int, got {probes!r}"
+        )
+    return int(probes)
+
+
+def _check_budget(budget) -> Optional[int]:
+    """Adaptive per-query candidate budget: None (uncapped — the probes
+    ceiling and early-exit bound alone stop escalation) or a strictly
+    positive real int."""
+    if budget is None:
+        return None
+    if (isinstance(budget, bool) or not isinstance(budget, numbers.Integral)
+            or budget < 1):
+        raise ValueError(
+            f"candidate_budget must be a positive int or None, got "
+            f"{budget!r}"
+        )
+    return int(budget)
 
 
 class BandPlan:
@@ -343,15 +407,42 @@ class BandedBuckets:
 def _check_probes(probes, default: int) -> int:
     """Per-call ``probes`` resolution, validated like the constructor
     knob (a float would silently truncate to fewer probes than the
-    caller computed): None → the serving default, else a non-negative
-    int (0 = the exact path)."""
+    caller computed, and a bool — which IS ``numbers.Integral`` — would
+    silently pin the exact path or probe once): None → the serving
+    default, else a non-negative real int (0 = the exact path)."""
     if probes is None:
         return default
-    if not isinstance(probes, numbers.Integral) or probes < 0:
+    if (isinstance(probes, bool) or not isinstance(probes, numbers.Integral)
+            or probes < 0):
         raise ValueError(
             f"probes must be a non-negative int, got {probes!r}"
         )
     return int(probes)
+
+
+def _merge_topm_rows(bd, bg, nd, ng, sentinel: int):
+    """Row-wise exact merge of two (dist, id) top-m planes under the
+    documented (distance, lower-global-id) order, deduplicating ids —
+    the union-of-top-m identity ``top_m(A ∪ B) = top_m(top_m(A) ∪
+    top_m(B))`` is what makes the adaptive tier's per-level rounds
+    exact over their cumulative candidate set.  A candidate surfacing
+    in two rounds has ONE distance (distance is a function of (query,
+    id)), so duplicates are key-identical and adjacent after the sort;
+    all-but-first re-key to the empty-slot sentinel pair."""
+    m = bd.shape[1]
+    d = np.concatenate([bd, nd], axis=1).astype(np.int64)
+    g = np.concatenate([bg, ng], axis=1).astype(np.int64)
+    key = (d << 32) | g
+    key.sort(axis=1)
+    dup = np.zeros(key.shape, bool)
+    dup[:, 1:] = key[:, 1:] == key[:, :-1]
+    key[dup] = (np.int64(sentinel) << 32) | int(_INT32_MAX)
+    key.sort(axis=1)
+    key = key[:, :m]
+    return (
+        (key >> 32).astype(np.int32),
+        (key & 0x7FFFFFFF).astype(np.int32),
+    )
 
 
 class LSHSimHashIndex(SimHashIndex):
@@ -382,23 +473,24 @@ class LSHSimHashIndex(SimHashIndex):
 
     def __init__(self, codes, *, bands: Optional[int] = None,
                  band_bits: Optional[int] = None, probes: int = 8,
-                 fallback_density: float = 0.1, **kw):
+                 fallback_density: float = 0.1, probe_path: str = "auto",
+                 adaptive: bool = False,
+                 candidate_budget: Optional[int] = None, **kw):
         if kw.get("mesh") is not None:
             raise ValueError(
                 "LSHSimHashIndex is single-device (one banded index is "
                 "one shard); shard a corpus with ann.LSHShardedSimHashIndex"
             )
-        if not isinstance(probes, numbers.Integral) or probes < 1:
-            raise ValueError(
-                f"probes must be a positive int, got {probes!r}"
-            )
+        self.probes = _check_ctor_probes(probes)
         if not 0.0 < float(fallback_density) <= 1.0:
             raise ValueError(
                 f"fallback_density must be in (0, 1], got "
                 f"{fallback_density!r}"
             )
-        self.probes = int(probes)
         self.fallback_density = float(fallback_density)
+        self.probe_path = _check_probe_path(probe_path)
+        self.adaptive = bool(adaptive)
+        self.candidate_budget = _check_budget(candidate_budget)
         self._lsh_cfg = (bands, band_bits)
         self._lsh_suspend = False
         self._masks_cache: dict = {}
@@ -407,6 +499,17 @@ class LSHSimHashIndex(SimHashIndex):
         # OOM'd once serves the host rung for the process lifetime
         # instead of re-paying the failed dispatch per tile
         self._lsh_fused_degraded: set = set()
+        # device-resident probe state (ISSUE 16): the CSR mirror is
+        # invalidated by a revision clock bumped from every bucket
+        # mutation, the tombstone vector by the (n_codes, n_deleted)
+        # pair, and shapes plan_probe cannot tile are memoized onto the
+        # host probe rung.  Initialized BEFORE the base constructor —
+        # the append hook fires during it.
+        self._lsh_dev_rev = 0
+        self._lsh_dev_csr = None      # (rev, indptr_dev, ids_dev)
+        self._lsh_dev_masks: dict = {}  # probes -> (1, P) int32 on device
+        self._lsh_dev_dead = None     # ((n_codes, n_deleted), dead_dev)
+        self._lsh_device_degraded: set = set()
         # resolve the band plan BEFORE the base constructor uploads the
         # bulk chunk, so the append hook folds rows directly — no
         # deferred copy of the corpus (which at the BL:10 scale would
@@ -428,8 +531,14 @@ class LSHSimHashIndex(SimHashIndex):
             return
         self._lsh_fold(codes)
 
+    def _lsh_buckets_changed(self) -> None:
+        """Invalidate the device-resident CSR mirror — the next device
+        probe dispatch re-uploads from the mutated host buckets."""
+        self._lsh_dev_rev += 1
+
     def _lsh_fold(self, codes: np.ndarray) -> None:
         rows = self._buckets.add(codes)
+        self._lsh_buckets_changed()
         telemetry.registry().counter_inc("index.lsh.builds")
         telemetry.emit(
             EVENTS.INDEX_LSH_BUILD, rows=int(rows),
@@ -443,6 +552,7 @@ class LSHSimHashIndex(SimHashIndex):
         # id remap itself (suspended — see compact())
         if not self._lsh_suspend and self._buckets is not None:
             self._buckets = BandedBuckets(self.band_plan)
+            self._lsh_buckets_changed()
         super()._rebuild_from_host(codes)
 
     def compact(self) -> np.ndarray:
@@ -461,6 +571,7 @@ class LSHSimHashIndex(SimHashIndex):
             self._buckets = BandedBuckets.from_keys(
                 self.band_plan, old_keys[:, mapping]
             )
+            self._lsh_buckets_changed()
             telemetry.registry().counter_inc("index.lsh.builds")
             telemetry.emit(
                 EVENTS.INDEX_LSH_BUILD, rows=int(self._buckets.n),
@@ -516,10 +627,16 @@ class LSHSimHashIndex(SimHashIndex):
             "candidates": reg.counter("index.lsh.candidates"),
             "probe_buckets": reg.counter("index.lsh.probe_buckets"),
             "builds": reg.counter("index.lsh.builds"),
+            "device_dispatches": reg.counter("index.lsh.device.dispatches"),
+            "device_uploads": reg.counter("index.lsh.device.uploads"),
+            "adaptive_tiles": reg.counter("index.lsh.adaptive.tiles"),
         }
 
     def query_topk(self, A, m: int, *, tile: int = 2048,
-                   probes: Optional[int] = None):
+                   probes: Optional[int] = None,
+                   probe_path: Optional[str] = None,
+                   adaptive: Optional[bool] = None,
+                   candidate_budget: Optional[int] = None):
         """Top-``m`` via the candidate tier: same contract as
         ``SimHashIndex.query_topk`` — ``(dist, idx)`` int32, ``m_eff =
         min(m, n_live)`` columns, (distance, lower-global-id) order —
@@ -528,6 +645,15 @@ class LSHSimHashIndex(SimHashIndex):
         overrides the serving default (``0`` = exact path; ``tile`` is
         also the candidate-union granularity — smaller tiles mean
         per-query-sharper candidate sets at more dispatches).
+
+        ``probe_path`` picks the candidate generator per call
+        (constructor default otherwise): ``'device'`` runs the fused
+        probe → dedup → gather → re-rank program (ISSUE 16 — one
+        dispatch per tile, no host CSR walk), ``'host'`` pins the r15
+        host probe rung, ``'auto'`` takes the device path on a real
+        accelerator only.  ``adaptive``/``candidate_budget`` control
+        device-side per-query probe escalation (see
+        ``_lsh_adaptive_tile``); both are inert on the host rung.
 
         Determinism under PARTIAL probes is per (query set, tile):
         the candidate union is tile-scoped, so grouping a query with
@@ -550,8 +676,14 @@ class LSHSimHashIndex(SimHashIndex):
                 "query_topk on an index whose codes are all deleted "
                 "(tombstoned); compact() or add() live codes first"
             )
+        device = self._lsh_probe_device(probe_path)
+        adaptive_eff = self.adaptive if adaptive is None else bool(adaptive)
+        budget_eff = (self.candidate_budget if candidate_budget is None
+                      else _check_budget(candidate_budget))
         m_eff = int(min(m, self.n_live))
         masks = self._probe_masks(p)
+        if device:
+            tile = self._lsh_device_tile(tile, p, m_eff)
         nq = A.shape[0]
         out_d = np.empty((nq, m_eff), dtype=np.int32)
         out_i = np.empty((nq, m_eff), dtype=np.int32)
@@ -563,6 +695,8 @@ class LSHSimHashIndex(SimHashIndex):
             lo, hi, kind, payload = entry
             if kind == "lsh":
                 d, i = self._lsh_finish_tile(payload, m_eff)
+            elif kind == "lsh_dev":
+                d, i = self._lsh_finish_device_tile(payload, m_eff)
             elif kind == "exact":
                 d, i = self._topk_finish_tile(payload, m_eff)
             else:  # 'done': served synchronously (dense host rung)
@@ -572,8 +706,9 @@ class LSHSimHashIndex(SimHashIndex):
 
         for lo in range(0, nq, tile):
             hi = min(lo + tile, nq)
-            kind, payload = self._lsh_dispatch_tile(
-                A[lo:hi], m_eff, masks, tile
+            kind, payload = self._lsh_tile_entry(
+                A[lo:hi], m_eff, masks, p, tile, device, adaptive_eff,
+                budget_eff,
             )
             pending.append((lo, hi, kind, payload))
             if len(pending) >= 2:
@@ -590,6 +725,7 @@ class LSHSimHashIndex(SimHashIndex):
         back to the exact device fan-out, ``('done', (d, i))`` when the
         exact path itself is host-scale (dense rung).  Shared with the
         sharded tier, which calls it per shard."""
+        t0 = time.perf_counter()
         qkeys = band_keys(a_np, self.band_plan)
         cand, gathered = self._buckets.candidates(qkeys, masks)
         if self._dead is not None and cand.size:
@@ -597,6 +733,11 @@ class LSHSimHashIndex(SimHashIndex):
             # gathered, so it can never win (ISSUE 15 storage contract)
             cand = cand[~self._dead[cand]]
         n_cand = int(cand.size)
+        # host-probe wall (the hop the device path removes): key
+        # extraction + CSR walk + np.unique dedup + tombstone filter
+        telemetry.registry().observe(
+            "index.lsh.probe.host_s", time.perf_counter() - t0
+        )
         nq = int(a_np.shape[0])
         n_probes = nq * self.band_plan.bands * int(masks.size)
         reg = telemetry.registry()
@@ -629,7 +770,10 @@ class LSHSimHashIndex(SimHashIndex):
                 candidate_fraction=round(frac, 6),
                 **telemetry.trace_fields(),
             )
-        return "lsh", self._lsh_rerank_dispatch(a_np, cand, m_eff)
+        t1 = time.perf_counter()
+        payload = self._lsh_rerank_dispatch(a_np, cand, m_eff)
+        reg.observe("index.lsh.probe.dispatch_s", time.perf_counter() - t1)
+        return "lsh", payload
 
     def _gather_codes_device(self, cand: np.ndarray):
         """Gather the candidate code rows ON DEVICE from the resident
@@ -721,6 +865,442 @@ class LSHSimHashIndex(SimHashIndex):
         dloc, iloc = _host_topk_select(D, m_eff)
         return dloc, cand[iloc].astype(np.int32)
 
+    # -- device-fused probe path (ISSUE 16) ----------------------------------
+
+    def _lsh_probe_device(self, probe_path: Optional[str]) -> bool:
+        """Resolve the per-call probe path: ``'device'`` forces the
+        fused device dispatch (interpreter included — the tier-1/CI
+        parity mode), ``'host'`` pins the r15 host probe rung,
+        ``'auto'`` takes the device path only on a real accelerator
+        (the interpreter is correctness-grade, not a serving win)."""
+        path = (self.probe_path if probe_path is None
+                else _check_probe_path(probe_path))
+        if path == "host":
+            return False
+        if path == "device":
+            return True
+        from randomprojection_tpu.ops import probe_kernels
+
+        return not probe_kernels.interpret_default()
+
+    def _lsh_device_tile(self, tile: int, p: int, m_eff: int) -> int:
+        """Clamp the serving tile to what one device-probe dispatch can
+        carry: ``plan_probe``'s ``tq`` is the per-launch query ceiling,
+        so a larger serving tile would force a per-tile degrade to the
+        host rung — clamping keeps every tile on the fused path at more
+        (cheap) dispatches."""
+        from randomprojection_tpu.ops import probe_kernels
+
+        pplan = probe_kernels.plan_probe(
+            min(int(tile), 1024), max(int(self._buckets.n), 1),
+            self.band_plan.bands, self.band_plan.band_bits, p, m_eff,
+        )
+        if pplan is not None:
+            tile = min(int(tile), pplan.tq)
+        return int(tile)
+
+    def _lsh_device_csr(self):
+        """The device-resident banded CSR mirror: per-band ``indptr``
+        clamped to int32 (ids are int32 by the append guard, so offsets
+        fit) stacked ``(bands, 2^b + 1)``, and per-band id runs packed
+        into a uniform ``(bands, n + _LSH_IDS_PAD)`` int32 plane (each
+        band holds exactly ``n`` ids — every row keys into every band)
+        with the pad sentinel-filled so a ragged last DMA block
+        overreads into sentinels, never clamps.  Cached against the
+        bucket revision clock; re-uploads emit
+        ``index.lsh.device_upload``."""
+        cached = self._lsh_dev_csr
+        if cached is not None and cached[0] == self._lsh_dev_rev:
+            return cached[1], cached[2]
+        t0 = time.perf_counter()
+        b = self._buckets
+        n = int(b.n)
+        indptr = np.stack([
+            np.minimum(ip, np.int64(_INT32_MAX)).astype(np.int32)
+            for ip in b._indptr
+        ])
+        ids = np.full(
+            (self.band_plan.bands, n + _LSH_IDS_PAD), _INT32_MAX, np.int32
+        )
+        for j, run in enumerate(b._ids):
+            ids[j, : run.size] = run
+        indptr_dev = self._device_queries(indptr)
+        ids_dev = self._device_queries(ids)
+        self._lsh_dev_csr = (self._lsh_dev_rev, indptr_dev, ids_dev)
+        telemetry.registry().counter_inc("index.lsh.device.uploads")
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.INDEX_LSH_DEVICE_UPLOAD, rows=n,
+                bands=self.band_plan.bands,
+                band_bits=self.band_plan.band_bits,
+                bytes=int(indptr.nbytes + ids.nbytes),
+                wall_s=round(time.perf_counter() - t0, 6),
+                **telemetry.trace_fields(),
+            )
+        return indptr_dev, ids_dev
+
+    def _lsh_device_dead(self):
+        """The FULL tombstone vector on device (``(n_codes,)`` uint8,
+        zeros when nothing is deleted — the probe program needs a dense
+        operand either way), cached against the ``(n_codes,
+        n_deleted)`` mutation clock like ``_chunk_dead_device``."""
+        key = (int(self.n_codes), int(self._n_deleted))
+        cached = self._lsh_dev_dead
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        if self._dead is None:
+            dead = np.zeros(self.n_codes, np.uint8)
+        else:
+            dead = self._dead.astype(np.uint8)
+        dead_dev = self._device_queries(dead)
+        self._lsh_dev_dead = (key, dead_dev)
+        return dead_dev
+
+    def _lsh_device_masks(self, p: int, masks: np.ndarray):
+        """The ``(1, P)`` int32 probe-mask plane on device, cached per
+        ``probes`` (pure combinatorics — same keying as the host mask
+        cache)."""
+        dev = self._lsh_dev_masks.get(p)
+        if dev is None:
+            dev = self._device_queries(
+                np.ascontiguousarray(masks.astype(np.int32))[None, :]
+            )
+            self._lsh_dev_masks[p] = dev
+        return dev
+
+    def _lsh_device_plans(self, nq: int, n_probes: int, m_eff: int):
+        """Resolve the (probe, re-rank) plan pair for one device
+        dispatch shape, or None when either planner cannot tile it —
+        the caller then degrades (r6: classify, memoize, emit)."""
+        from randomprojection_tpu.ops import probe_kernels, topk_kernels
+
+        pplan = probe_kernels.plan_probe(
+            nq, int(self._buckets.n), self.band_plan.bands,
+            self.band_plan.band_bits, n_probes, m_eff,
+        )
+        if pplan is None or pplan.tq < nq:
+            return None
+        fplan = topk_kernels.plan_fused(
+            pplan.tq, pplan.cap, self.n_bytes, m_eff
+        )
+        if fplan is None:
+            return None
+        return pplan, fplan
+
+    def _lsh_device_dispatch_tile(self, a_np, m_eff: int,
+                                  masks: np.ndarray, p: int, tile: int):
+        """One fused device-probe dispatch: pad the tile to the plan's
+        ``tq``, upload queries + active mask (the only per-tile host
+        bytes — no CSR walk, no ``np.unique``), launch the fused
+        probe → dedup → gather → re-rank program and START the d2h.
+        Returns ``('lsh_dev', payload)``, or None when the shape has no
+        plan — memoized per shape, ``index.lsh.fallback`` reason
+        ``device_plan``, and the caller serves the host probe rung."""
+        nq = int(a_np.shape[0])
+        memo_key = (nq, int(self._buckets.n), p, m_eff)
+        reg = telemetry.registry()
+        if memo_key in self._lsh_device_degraded:
+            return None
+        plans = self._lsh_device_plans(nq, int(masks.size), m_eff)
+        if plans is None:
+            self._lsh_device_degraded.add(memo_key)
+            reg.counter_inc("index.lsh.fallbacks")
+            telemetry.emit(
+                EVENTS.INDEX_LSH_FALLBACK, reason="device_plan",
+                queries=nq, probes=int(masks.size),
+                n_live=int(self.n_live),
+                **telemetry.trace_fields(),
+            )
+            return None
+        pplan, fplan = plans
+        t0 = time.perf_counter()
+        indptr_dev, ids_dev = self._lsh_device_csr()
+        dead_dev = self._lsh_device_dead()
+        masks_dev = self._lsh_device_masks(p, masks)
+        qp = a_np
+        if nq < pplan.tq:
+            qp = np.zeros((pplan.tq, a_np.shape[1]), np.uint8)
+            qp[:nq] = a_np
+        active = np.zeros((1, pplan.tq), np.int32)
+        active[0, :nq] = 1
+        q_dev = self._device_queries(qp)
+        act_dev = self._device_queries(active)
+        # device-path "host probe" wall is upload prep only — the A/B
+        # against the host rung's CSR-walk wall is the bench headline
+        reg.observe("index.lsh.probe.host_s", time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        from randomprojection_tpu.ops import probe_kernels
+
+        d, gid, stat, _cnt = probe_kernels.device_probe_topk(
+            q_dev, masks_dev, act_dev, indptr_dev, ids_dev, dead_dev,
+            [c.b for c in self._chunks],
+            [c.row0 for c in self._chunks],
+            [c.n for c in self._chunks],
+            m_eff, pplan=pplan, fplan=fplan,
+            band_bits=self.band_plan.band_bits,
+        )
+        _start_host_copy(d)
+        _start_host_copy(gid)
+        _start_host_copy(stat)
+        reg.observe("index.lsh.probe.dispatch_s", time.perf_counter() - t1)
+        return "lsh_dev", (d, gid, stat, nq, p, tile, a_np)
+
+    def _lsh_finish_device_tile(self, payload, m_eff: int):
+        """Materialize one fused dispatch and apply the POST-HOC
+        fallback ladder: the device program cannot consult the density
+        gate before launching (the candidate count is ITS output), so
+        the ladder reads the stats plane at finish time — candidate-
+        slot overflow → ``device_budget``, fewer live candidates than
+        ``m_eff`` → ``starved``, union denser than the gate →
+        ``dense`` — and any rung serves the tile through the exact
+        path (the tier never serves worse than exact)."""
+        d, gid, stat, nq, p, tile, a_np = payload
+        stat = np.asarray(stat)
+        overflow = int(stat[1]) != 0
+        n_cand = int(stat[2])
+        reg = telemetry.registry()
+        dense = n_cand > self.fallback_density * self.n_live
+        if overflow or n_cand < m_eff or dense:
+            reason = ("device_budget" if overflow
+                      else "starved" if n_cand < m_eff else "dense")
+            reg.counter_inc("index.lsh.fallbacks")
+            telemetry.emit(
+                EVENTS.INDEX_LSH_FALLBACK, reason=reason, queries=nq,
+                probes=int(p), candidates=n_cand,
+                n_live=int(self.n_live),
+                threshold=self.fallback_density,
+                **telemetry.trace_fields(),
+            )
+            return SimHashIndex.query_topk(self, a_np, m_eff, tile=tile)
+        frac = n_cand / max(self.n_live, 1)
+        reg.counter_inc("index.lsh.dispatches")
+        reg.counter_inc("index.lsh.device.dispatches")
+        reg.counter_inc(
+            "index.lsh.probe_buckets", nq * self.band_plan.bands * p
+        )
+        reg.counter_inc("index.lsh.candidates", n_cand)
+        reg.gauge_set("index.lsh.candidate_fraction", frac)
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.INDEX_LSH_DEVICE_DISPATCH, queries=nq,
+                m=int(m_eff), probes=int(p), bands=self.band_plan.bands,
+                candidates=n_cand, gathered=int(stat[0]),
+                candidate_fraction=round(frac, 6),
+                **telemetry.trace_fields(),
+            )
+        dist = np.asarray(d)[:nq, :m_eff]
+        idx = np.asarray(gid)[:nq, :m_eff].astype(np.int32)
+        return dist, idx
+
+    def _lsh_tile_entry(self, a_np, m_eff: int, masks: np.ndarray,
+                        p: int, tile: int, device: bool, adaptive: bool,
+                        budget: Optional[int]):
+        """Route one query tile down the probe ladder: adaptive device
+        rounds → fixed device-fused dispatch → host probe rung (which
+        itself ladders to the exact path).  Adaptive probing is a
+        device-path feature — on the host rung the fixed ``probes``
+        serve (never fewer candidates, never worse answers)."""
+        if device:
+            if adaptive:
+                served = self._lsh_adaptive_tile(
+                    a_np, m_eff, p, tile, budget
+                )
+            else:
+                served = self._lsh_device_dispatch_tile(
+                    a_np, m_eff, masks, p, tile
+                )
+            if served is not None:
+                return served
+        return self._lsh_dispatch_tile(a_np, m_eff, masks, tile)
+
+    def _lsh_adaptive_tile(self, a_np, m_eff: int, p: int, tile: int,
+                           budget: Optional[int]):
+        """Adaptive per-query probing: host-orchestrated ROUNDS of the
+        fused device dispatch, one per popcount LEVEL of the (popcount,
+        ascending value) probe sequence, with a per-query active mask —
+        easy queries retire early, hard queries escalate toward the
+        ``probes`` ceiling.
+
+        Safety is by construction.  (1) Early exit is sound: after
+        every popcount-``f`` mask has been probed, a candidate still
+        unseen by query ``q`` differs from ``q``'s key by ≥ ``f+1``
+        bits in EVERY band (else some probed bucket held it), and bands
+        are disjoint bit ranges, so its distance is ≥ ``bands·(f+1)``;
+        a query whose running m-th distance is STRICTLY below that
+        bound can never be improved — nor tie-displaced (strictness
+        covers the lower-id tie-break) — by any unprobed bucket.  The
+        bound also covers tile-union cross-contamination: a candidate
+        surfaced by a NEIGHBOR query but absent from ``q``'s probed
+        buckets satisfies the same per-band inequality for ``q``.
+        (2) Rounds merge exactly: ``top_m(A ∪ B) = top_m(top_m(A) ∪
+        top_m(B))`` (``_merge_topm_rows``), so the running plane always
+        equals the fixed-probes answer over the cumulative probe set.
+        (3) Recall is monotone in ``candidate_budget``: a larger budget
+        never deactivates a query earlier, so its effective probe set
+        — and hence its candidate set — is a superset.  The truncated
+        final level (a ``probes`` ceiling cutting a popcount class
+        short) never early-exits on its own bound.
+
+        Degrades whole-tile to the fixed path (return None) when any
+        level has no plan (``device_plan``, memoized) or any round
+        overflows its candidate slots (``device_budget``); queries
+        still starved after the final round are served exactly
+        (``starved`` rung), so the returned plane is always full."""
+        from randomprojection_tpu.ops import probe_kernels
+
+        nq = int(a_np.shape[0])
+        bands = self.band_plan.bands
+        reg = telemetry.registry()
+        memo_key = ("adaptive", nq, int(self._buckets.n), p, m_eff)
+        if memo_key in self._lsh_device_degraded:
+            return None
+        masks = self._probe_masks(p)
+        pc = np.array([bin(int(x)).count("1") for x in masks], np.int64)
+        # level f = the run of masks with popcount f (sequence order
+        # groups them); the ceiling p may truncate the last level
+        bnd = np.flatnonzero(np.diff(pc)) + 1
+        levels = list(
+            zip(np.concatenate(([0], bnd)),
+                np.concatenate((bnd, [masks.size])))
+        )
+        full_bits = self.band_plan.band_bits
+        plans = []
+        for lo, hi in levels:
+            pl = self._lsh_device_plans(nq, int(hi - lo), m_eff)
+            if pl is None:
+                self._lsh_device_degraded.add(memo_key)
+                reg.counter_inc("index.lsh.fallbacks")
+                telemetry.emit(
+                    EVENTS.INDEX_LSH_FALLBACK, reason="device_plan",
+                    queries=nq, probes=int(hi - lo),
+                    n_live=int(self.n_live), adaptive=True,
+                    **telemetry.trace_fields(),
+                )
+                return None
+            plans.append(pl)
+        sent_d = np.int32(self.n_bits + 1)
+        best_d = np.full((nq, m_eff), sent_d, np.int32)
+        best_g = np.full((nq, m_eff), _INT32_MAX, np.int32)
+        active = np.ones(nq, bool)
+        used = np.zeros(nq, np.int64)
+        yielded = np.zeros(nq, np.int64)
+        early_exits = budget_stops = rounds = 0
+        live_cands = probe_buckets = 0
+        t0 = time.perf_counter()
+        indptr_dev, ids_dev = self._lsh_device_csr()
+        dead_dev = self._lsh_device_dead()
+        reg.observe("index.lsh.probe.host_s", time.perf_counter() - t0)
+        for f, (lo, hi) in enumerate(levels):
+            if not active.any():
+                break
+            pplan, fplan = plans[f]
+            t1 = time.perf_counter()
+            level_masks = self._device_queries(
+                np.ascontiguousarray(masks[lo:hi].astype(np.int32))[None, :]
+            )
+            qp = a_np
+            if nq < pplan.tq:
+                qp = np.zeros((pplan.tq, a_np.shape[1]), np.uint8)
+                qp[:nq] = a_np
+            act = np.zeros((1, pplan.tq), np.int32)
+            act[0, :nq] = active
+            d, gid, stat, cnt = probe_kernels.device_probe_topk(
+                self._device_queries(qp), level_masks,
+                self._device_queries(act), indptr_dev, ids_dev,
+                dead_dev,
+                [c.b for c in self._chunks],
+                [c.row0 for c in self._chunks],
+                [c.n for c in self._chunks],
+                m_eff, pplan=pplan, fplan=fplan,
+                band_bits=self.band_plan.band_bits,
+            )
+            stat = np.asarray(stat)  # rplint: allow[RP03] — host-orchestrated round: the overflow verdict gates whether the NEXT level may launch, so this sync IS the orchestration point
+            reg.observe(
+                "index.lsh.probe.dispatch_s", time.perf_counter() - t1
+            )
+            rounds += 1
+            reg.counter_inc("index.lsh.device.dispatches")
+            if int(stat[1]) != 0:
+                reg.counter_inc("index.lsh.fallbacks")
+                telemetry.emit(
+                    EVENTS.INDEX_LSH_FALLBACK, reason="device_budget",
+                    queries=nq, probes=int(hi - lo),
+                    n_live=int(self.n_live), adaptive=True,
+                    **telemetry.trace_fields(),
+                )
+                return None
+            # The per-round merge and the early-exit bound both read
+            # these on host before the next level can launch; the sync
+            # is the adaptive control point, not an accidental stall
+            # (the fixed-probe path overlaps d2h via _start_host_copy).
+            nd = np.asarray(d)[:nq, :m_eff]  # rplint: allow[RP03] — see above: round results feed the host-side merge deciding the next launch
+            ng = np.asarray(gid)[:nq, :m_eff]  # rplint: allow[RP03] — see above
+            cnt = np.asarray(cnt)[:nq]  # rplint: allow[RP03] — see above
+            # merge ACTIVE rows only: retired queries stay frozen (their
+            # plane is already proven-final or budget-stopped), which is
+            # what makes the budget-monotonicity superset argument hold
+            best_d[active], best_g[active] = _merge_topm_rows(
+                best_d[active], best_g[active], nd[active], ng[active],
+                int(sent_d),
+            )
+            used[active] += int(hi - lo)
+            yielded[active] += cnt[active]
+            live_cands += int(stat[2])
+            probe_buckets += int(active.sum()) * bands * int(hi - lo)
+            if int(hi - lo) == _level_size(full_bits, f):
+                # complete level: the bands·(f+1) bound holds
+                mth = best_d[:, m_eff - 1]
+                exiting = active & (mth < bands * (f + 1))
+                early_exits += int(exiting.sum())
+                active &= ~exiting
+            if budget is not None:
+                stops = active & (yielded >= budget)
+                budget_stops += int(stops.sum())
+                active &= ~stops
+        starved = best_g[:, m_eff - 1] == _INT32_MAX
+        if starved.any():
+            reg.counter_inc("index.lsh.fallbacks")
+            telemetry.emit(
+                EVENTS.INDEX_LSH_FALLBACK, reason="starved",
+                queries=int(starved.sum()), probes=int(p),
+                n_live=int(self.n_live), adaptive=True,
+                **telemetry.trace_fields(),
+            )
+            sd, si = SimHashIndex.query_topk(
+                self, np.ascontiguousarray(a_np[starved]), m_eff,
+                tile=tile,
+            )
+            best_d[starved] = sd
+            best_g[starved] = si.astype(np.int32)
+        frac = live_cands / max(self.n_live, 1)
+        reg.counter_inc("index.lsh.dispatches")
+        reg.counter_inc("index.lsh.adaptive.tiles")
+        reg.counter_inc("index.lsh.probe_buckets", probe_buckets)
+        reg.counter_inc("index.lsh.candidates", live_cands)
+        reg.gauge_set("index.lsh.candidate_fraction", frac)
+        for u in used:
+            reg.observe("index.lsh.adaptive.probes_used", float(u))
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.INDEX_LSH_ADAPTIVE, queries=nq, m=int(m_eff),
+                probes_ceiling=int(p), rounds=rounds,
+                probes_used_mean=round(float(used.mean()), 3),
+                probes_used_max=int(used.max()),
+                early_exits=early_exits, budget_stops=budget_stops,
+                starved=int(starved.sum()), candidates=live_cands,
+                candidate_fraction=round(frac, 6),
+                **telemetry.trace_fields(),
+            )
+        return "done", (best_d, best_g)
+
+
+def _level_size(band_bits: int, f: int):
+    """Number of ``band_bits``-bit masks with popcount ``f`` — the full
+    size of popcount level ``f`` (math.comb)."""
+    import math
+
+    return math.comb(band_bits, f)
+
 
 class LSHShardedSimHashIndex(ShardedSimHashIndex):
     """``ShardedSimHashIndex`` whose shards carry banded multi-probe
@@ -740,18 +1320,19 @@ class LSHShardedSimHashIndex(ShardedSimHashIndex):
 
     def __init__(self, codes, *, bands: Optional[int] = None,
                  band_bits: Optional[int] = None, probes: int = 8,
-                 fallback_density: float = 0.1, **kw):
-        if not isinstance(probes, numbers.Integral) or probes < 1:
-            raise ValueError(
-                f"probes must be a positive int, got {probes!r}"
-            )
+                 fallback_density: float = 0.1, probe_path: str = "auto",
+                 adaptive: bool = False,
+                 candidate_budget: Optional[int] = None, **kw):
+        self.probes = _check_ctor_probes(probes)
         if not 0.0 < float(fallback_density) <= 1.0:
             raise ValueError(
                 f"fallback_density must be in (0, 1], got "
                 f"{fallback_density!r}"
             )
-        self.probes = int(probes)
         self.fallback_density = float(fallback_density)
+        self.probe_path = _check_probe_path(probe_path)
+        self.adaptive = bool(adaptive)
+        self.candidate_budget = _check_budget(candidate_budget)
         self._lsh_cfg = (bands, band_bits)
         super().__init__(codes, **kw)
         self.band_plan = self._shards[0].band_plan
@@ -764,6 +1345,8 @@ class LSHShardedSimHashIndex(ShardedSimHashIndex):
             label=f"shard {s}/{len(self._devices)} on {dev}",
             bands=bands, band_bits=band_bits, probes=self.probes,
             fallback_density=self.fallback_density,
+            probe_path=self.probe_path, adaptive=self.adaptive,
+            candidate_budget=self.candidate_budget,
         )
 
     def _lsh_global_keys(self) -> np.ndarray:
@@ -800,12 +1383,17 @@ class LSHShardedSimHashIndex(ShardedSimHashIndex):
         )
 
     def query_topk(self, A, m: int, *, tile: int = 2048,
-                   probes: Optional[int] = None):
+                   probes: Optional[int] = None,
+                   probe_path: Optional[str] = None,
+                   adaptive: Optional[bool] = None,
+                   candidate_budget: Optional[int] = None):
         """Top-``m`` across every shard via per-shard candidate
         generation + exact re-rank + the documented (distance,
         lower-global-id) cross-shard merge.  Same contract as the base
         ``query_topk`` (``dist`` int32, ``idx`` int64 global ids,
-        ``m_eff = min(m, n_live)``)."""
+        ``m_eff = min(m, n_live)``); ``probe_path`` / ``adaptive`` /
+        ``candidate_budget`` route every shard's probe ladder exactly
+        as on ``LSHSimHashIndex.query_topk``."""
         p = _check_probes(probes, self.probes)
         if p == 0:
             return super().query_topk(A, m, tile=tile)
@@ -819,11 +1407,23 @@ class LSHShardedSimHashIndex(ShardedSimHashIndex):
                 "query_topk on an index whose codes are all deleted "
                 "(tombstoned); compact() or add() live codes first"
             )
+        device = self._shards[0]._lsh_probe_device(probe_path)
+        adaptive_eff = self.adaptive if adaptive is None else bool(adaptive)
+        budget_eff = (self.candidate_budget if candidate_budget is None
+                      else _check_budget(candidate_budget))
         m_eff = int(min(m, self.n_live))
         # shard 0's mask cache serves the whole tier (shards share one
         # band plan): the perturbation sequence is pure combinatorics,
         # not something to recompute per coalesced serving batch
         masks = self._shards[0]._probe_masks(p)
+        if device:
+            # one serving tile feeds EVERY shard's dispatch, so it
+            # clamps to the tightest per-shard probe plan
+            for shard in self._shards:
+                if shard.n_live > 0:
+                    tile = shard._lsh_device_tile(
+                        tile, p, int(min(m_eff, shard.n_live))
+                    )
         nq = A.shape[0]
         out_d = np.empty((nq, m_eff), dtype=np.int32)
         out_i = np.empty((nq, m_eff), dtype=np.int64)
@@ -836,6 +1436,10 @@ class LSHShardedSimHashIndex(ShardedSimHashIndex):
                 shard = self._shards[si]
                 if kind == "lsh":
                     d_s, li_s = shard._lsh_finish_tile(payload, m_s)
+                elif kind == "lsh_dev":
+                    d_s, li_s = shard._lsh_finish_device_tile(
+                        payload, m_s
+                    )
                 elif kind == "exact":
                     d_s, li_s = shard._topk_finish_tile(payload, m_s)
                 else:  # 'done'
@@ -854,8 +1458,9 @@ class LSHShardedSimHashIndex(ShardedSimHashIndex):
                 if shard.n_live == 0:
                     continue  # empty or fully-tombstoned shard
                 m_s = int(min(m_eff, shard.n_live))
-                kind, payload = shard._lsh_dispatch_tile(
-                    tile_a, m_s, masks, tile
+                kind, payload = shard._lsh_tile_entry(
+                    tile_a, m_s, masks, p, tile, device, adaptive_eff,
+                    budget_eff,
                 )
                 per_shard.append((si, kind, payload, m_s))
             telemetry.registry().counter_inc(

@@ -81,10 +81,65 @@ def main() -> None:
         "(cross-shard tombstones)"
     )
 
+    # device-fused probe path (ISSUE 16): the on-device probe → gather →
+    # re-rank dispatch (Pallas interpreter on CPU — the same kernels a
+    # chip runs) must be BIT-IDENTICAL to the host probe path and, at
+    # full coverage, to brute force.  probe_path="device" forces the
+    # fused path (auto resolves to host under the interpreter).
+    full4 = 1 << 4
+    dv = LSHSimHashIndex(codes[:700], bands=4, band_bits=4,
+                         fallback_density=1.0, probe_path="device")
+    dv.add(codes[700:])              # second resident chunk
+    dv.delete(np.arange(650, 760))   # tombstones spanning the chunk seam
+    Dv = D.astype(np.int64)
+    Dv[:, 650:760] = 8 * 8 + 1
+    rdv, riv = sk._host_topk_select(Dv, m)
+    dd, di = dv.query_topk(queries, m, probes=full4)
+    assert np.array_equal(dd, rdv) and np.array_equal(di, riv), (
+        "device-path full-probe LSH != brute force "
+        "(multi-chunk + tombstones)"
+    )
+    hd, hi = dv.query_topk(queries, m, probes=3, probe_path="host")
+    pd_, pi_ = dv.query_topk(queries, m, probes=3)
+    assert np.array_equal(pd_, hd) and np.array_equal(pi_, hi), (
+        "device-path partial-probe answers != host probe path"
+    )
+
+    # ragged n_bits (61 of 64): device vs host parity at full coverage
+    rg_h = LSHSimHashIndex(codes, bands=4, band_bits=4, n_bits=61,
+                           fallback_density=1.0, probe_path="host")
+    rg_d = LSHSimHashIndex(codes, bands=4, band_bits=4, n_bits=61,
+                           fallback_density=1.0, probe_path="device")
+    hd, hi = rg_h.query_topk(queries, m, probes=full4)
+    dd, di = rg_d.query_topk(queries, m, probes=full4)
+    assert np.array_equal(dd, hd) and np.array_equal(di, hi), (
+        "device-path LSH != host path at ragged n_bits=61"
+    )
+
+    # 8-shard device path with cross-shard tombstones (one shard wholly
+    # dead): full coverage == the same masked brute force as the host leg
+    sh2 = LSHShardedSimHashIndex(codes, n_shards=8, bands=4, band_bits=4,
+                                 fallback_density=1.0,
+                                 probe_path="device")
+    sh2.delete(dead)
+    Dm4 = D.astype(np.int64)
+    Dm4[:, dead] = 8 * 8 + 1
+    rdm4, rim4 = sk._host_topk_select(Dm4, m)
+    dm2, im2 = sh2.query_topk(queries, m, probes=full4)
+    assert np.array_equal(dm2, rdm4), (
+        "sharded device-path full-probe LSH dist != masked brute force"
+    )
+    assert np.array_equal(im2, rim4.astype(np.int64)), (
+        "sharded device-path full-probe LSH ids != masked brute force "
+        "(cross-shard tombstones)"
+    )
+
     print(
         f"ann-smoke OK: full-probe LSH == exact == brute force on "
         f"{n_dev} device(s) (single + 8-shard, cross-shard tombstones); "
-        "density fallback exact; partial-probe distances exact"
+        "density fallback exact; partial-probe distances exact; "
+        "device-fused probe path bit-identical to host (multi-chunk, "
+        "tombstones, ragged n_bits, 8-shard)"
     )
 
 

@@ -1129,6 +1129,11 @@ def _lsh_counters() -> tuple:
         reg.counter("index.lsh.dispatches"),
         reg.counter("index.lsh.candidates"),
         reg.counter("index.lsh.fallbacks"),
+        # host-vs-device wall split (ISSUE 16): host probe/prep seconds
+        # vs fused dispatch seconds — deltas of these sums bracket the
+        # timed window per curve point
+        reg.hist_sum("index.lsh.probe.host_s") or 0.0,
+        reg.hist_sum("index.lsh.probe.dispatch_s") or 0.0,
     )
 
 
@@ -1191,7 +1196,7 @@ def measure_topk_lsh(preset: str = "full") -> dict:
         for row_got, row_true in zip(got_i, true_i):
             hits += np.intersect1d(row_got, row_true).size
         recall = hits / true_i.size
-        d0, c0, f0 = _lsh_counters()
+        d0, c0, f0, h0, w0 = _lsh_counters()
         t0 = time.perf_counter()
         for c in range(calls):
             index.query_topk(
@@ -1199,7 +1204,7 @@ def measure_topk_lsh(preset: str = "full") -> dict:
                 tile=rerank_tile, probes=probes,
             )
         elapsed = time.perf_counter() - t0
-        d1, c1, f1 = _lsh_counters()
+        d1, c1, f1, h1, w1 = _lsh_counters()
         tiles = d1 - d0
         frac = (
             (c1 - c0) / tiles / index.n_live if tiles else None
@@ -1212,6 +1217,11 @@ def measure_topk_lsh(preset: str = "full") -> dict:
             ),
             "queries_per_s": round(calls * nq / elapsed, 1),
             "fallbacks": int(f1 - f0),
+            # the host-hop the device path removes, made visible: host
+            # probe/prep wall vs fused-dispatch wall inside the timed
+            # window (interpreter runs flag both suspect, no tripwire)
+            "probe_host_s": round(h1 - h0, 6),
+            "probe_dispatch_s": round(w1 - w0, 6),
             "timing_suspect": bool(topk_kernels.interpret_default()),
         })
 
@@ -1240,6 +1250,13 @@ def measure_topk_lsh(preset: str = "full") -> dict:
         "rerank_tile": rerank_tile,
         "exact_queries_per_s": round(exact_qps, 1),
         "topk_interpret": topk_kernels.interpret_default(),
+        # the candidate path auto-resolution (ISSUE 16): device-fused
+        # probe → gather → re-rank on chips, host probe rung under the
+        # interpreter — the wall split fields read against this
+        "probe_path": "auto",
+        "probe_path_resolved": (
+            "device" if index._lsh_probe_device(None) else "host"
+        ),
         "curve": curve,
         "recall_gate": LSH_RECALL_GATE,
         "candidate_fraction_gate": LSH_CANDIDATE_FRACTION_GATE,
@@ -1693,6 +1710,15 @@ def compact_summary(record: dict) -> dict:
                 c4d["topk_lsh_timing_suspect"] = bool(
                     hl.get("timing_suspect")
                 )
+                # host-vs-device wall split at the headline point
+                # (ISSUE 16): the host-hop removal, gate-free
+                c4d["topk_lsh_probe_host_s"] = _sig(
+                    hl.get("probe_host_s"), 3
+                )
+                c4d["topk_lsh_probe_dispatch_s"] = _sig(
+                    hl.get("probe_dispatch_s"), 3
+                )
+            c4d["topk_lsh_probe_path"] = lsh.get("probe_path_resolved")
     regs = record.get("regressions", [])
     if len(regs) > 8:
         c["regressions_truncated"] = len(regs) - 8

@@ -381,6 +381,20 @@ def build_parser():
                    help="LSH band count (0 = auto: min(8, bits/band))")
     q.add_argument("--lsh-band-bits", type=int, default=0,
                    help="LSH bits per band key (0 = auto: min(16, bits))")
+    q.add_argument("--probe-path", default="auto",
+                   choices=["auto", "host", "device"],
+                   help="LSH candidate generation path: 'device' runs "
+                        "the fused on-device probe→gather→re-rank "
+                        "program, 'host' pins the host CSR-walk rung, "
+                        "'auto' picks device on a real accelerator only")
+    q.add_argument("--adaptive", action="store_true",
+                   help="adaptive per-query probing on the device path: "
+                        "each --probes value becomes the per-query "
+                        "ceiling and the record carries probes-used "
+                        "histograms")
+    q.add_argument("--candidate-budget", type=int, default=0,
+                   help="adaptive per-query candidate budget "
+                        "(0 = uncapped)")
     q.add_argument("--seed", type=int, default=0)
     _add_observability(q)
 
@@ -415,12 +429,15 @@ def build_parser():
     q.add_argument("--topk-impl", default="auto",
                    choices=["auto", "fused", "scan"],
                    help="query_topk device path per shard")
-    q.add_argument("--probes", type=int, default=0, metavar="P",
+    q.add_argument("--probes", default="0", metavar="P|label=P,...",
                    help="serve through the multi-probe LSH candidate "
-                        "tier (ann.LSHShardedSimHashIndex) probing P "
-                        "buckets per band — the recall/latency knob the "
-                        "per-label SLO record expresses (0 = exact "
-                        "scan tier)")
+                        "tier (ann.LSHShardedSimHashIndex): a bare int "
+                        "P probes P buckets per band for ALL labels "
+                        "(0 = exact scan tier); 'label=P,...' pairs set "
+                        "a PER-LABEL probe policy (unlisted labels use "
+                        "the tier default; P=0 pins a label onto the "
+                        "exact path) — the mixed quality classes the "
+                        "per-label SLO record expresses")
     q.add_argument("--rate", type=float, default=50.0, metavar="QPS",
                    help="mean offered request rate (requests/s)")
     q.add_argument("--duration", type=float, default=5.0, metavar="SEC",
@@ -1032,11 +1049,17 @@ def cmd_topk_bench(args):
                 f"--probes wants a comma list of positive ints, got "
                 f"{args.probes!r}"
             )
+        from randomprojection_tpu.ops.probe_kernels import interpret_default
+        from randomprojection_tpu.utils import telemetry as _telemetry
+
         lsh_index = LSHSimHashIndex(
             codes,
             bands=args.lsh_bands or None,
             band_bits=args.lsh_band_bits or None,
             topk_impl=args.topk_impl,
+            probe_path=args.probe_path,
+            adaptive=bool(args.adaptive),
+            candidate_budget=args.candidate_budget or None,
         )
         # exact truth for recall@m: brute force over the same corpus
         # (host reference — the documented tie order)
@@ -1045,6 +1068,7 @@ def cmd_topk_bench(args):
         # warm the re-rank compile buckets before any timed loop
         lsh_index.query_topk(pool[:ref_rows], args.m,
                              probes=probe_counts[0])
+        reg = _telemetry.registry()
         lsh_curve = []
         for p in probe_counts:
             gd, gi = lsh_index.query_topk(pool[:ref_rows], args.m,
@@ -1052,21 +1076,59 @@ def cmd_topk_bench(args):
             hits = 0
             for row_got, row_true in zip(gi, true_i):
                 hits += np.intersect1d(row_got, row_true).size
+            # per-tile wall split (ISSUE 16): host-probe work (CSR walk
+            # + dedup on the host rung; upload prep on the device rung)
+            # vs dispatch wall — hist_sum deltas over the timed loop
+            h0 = reg.hist_sum("index.lsh.probe.host_s")
+            s0 = reg.hist_sum("index.lsh.probe.dispatch_s")
+            u0 = reg.hist_quantiles("index.lsh.adaptive.probes_used")
             t0 = time.perf_counter()
             for req in requests:
                 lsh_index.query_topk(req, args.m, probes=p)
             elapsed = time.perf_counter() - t0
-            lsh_curve.append({
+            point = {
                 "probes": p,
                 "recall_at_m": round(hits / true_i.size, 4),
                 "queries_per_s": round(
                     len(requests) * args.request_rows / elapsed, 1
                 ),
-            })
+                "probe_host_s": round(
+                    reg.hist_sum("index.lsh.probe.host_s") - h0, 6
+                ),
+                "probe_dispatch_s": round(
+                    reg.hist_sum("index.lsh.probe.dispatch_s") - s0, 6
+                ),
+            }
+            if args.adaptive:
+                u1 = reg.hist_quantiles("index.lsh.adaptive.probes_used")
+                if u1 is not None:
+                    n0 = u0["count"] if u0 else 0
+                    s_0 = u0["sum"] if u0 else 0.0
+                    point["probes_used"] = {
+                        "count": u1["count"] - n0,
+                        "mean": round(
+                            (u1["sum"] - s_0)
+                            / max(u1["count"] - n0, 1), 3
+                        ),
+                        # cumulative-histogram estimates (log2 buckets)
+                        "p50": u1.get("p50"),
+                        "p99": u1.get("p99"),
+                    }
+            lsh_curve.append(point)
         lsh = {
             "bands": lsh_index.band_plan.bands,
             "band_bits": lsh_index.band_plan.band_bits,
             "fallback_density": lsh_index.fallback_density,
+            "probe_path": args.probe_path,
+            "probe_path_resolved": (
+                "device" if lsh_index._lsh_probe_device(args.probe_path)
+                else "host"
+            ),
+            "adaptive": bool(args.adaptive),
+            "candidate_budget": args.candidate_budget or None,
+            # interpreter wall-splits are correctness-grade only: never
+            # a tripwire baseline (r6–r14 convention)
+            "wall_split_suspect": bool(interpret_default()),
             "curve": lsh_curve,
             **{f"lsh_{k}": v for k, v in lsh_index.lsh_stats().items()},
         }
@@ -1137,15 +1199,58 @@ def cmd_loadgen(args):
     codes = rng.integers(
         0, 256, size=(args.index_codes, args.code_bytes), dtype=np.uint8
     )
-    if args.probes > 0:
+    # --probes: a bare int serves every label at that probe count; a
+    # 'label=P,...' list sets a PER-LABEL probe policy (ISSUE 16 —
+    # mixed quality classes against one serving tier; unlisted labels
+    # take the tier default, P=0 pins a label onto the exact path)
+    probes_txt = str(args.probes).strip()
+    probes_default = 0
+    probe_policy = None
+    if "=" in probes_txt:
+        probe_policy = {}
+        for part in probes_txt.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, eq, v = part.partition("=")
+            try:
+                if not eq:
+                    raise ValueError(part)
+                probe_policy[k.strip()] = int(v)
+            except ValueError:
+                raise SystemExit(
+                    f"--probes wants an int or label=P pairs, got "
+                    f"{part!r}"
+                )
+        if not probe_policy or any(
+            p < 0 for p in probe_policy.values()
+        ):
+            raise SystemExit(
+                f"--probes label=P pairs want non-negative probe "
+                f"counts, got {probes_txt!r}"
+            )
+    else:
+        try:
+            probes_default = int(probes_txt or "0")
+        except ValueError:
+            raise SystemExit(
+                f"--probes wants an int or label=P pairs, got "
+                f"{probes_txt!r}"
+            )
+        if probes_default < 0:
+            raise SystemExit(
+                f"--probes must be >= 0, got {probes_default}"
+            )
+    if probes_default > 0 or probe_policy is not None:
         # the LSH candidate tier serves: probes is the recall/latency
         # knob the per-label SLO tables then express (ISSUE 15)
         from randomprojection_tpu.ann import LSHShardedSimHashIndex
 
+        lsh_kw = {"probes": probes_default} if probes_default > 0 else {}
         groups = [
             LSHShardedSimHashIndex(
                 codes, n_shards=args.shards, topk_impl=args.topk_impl,
-                probes=args.probes,
+                **lsh_kw,
             )
             for _ in range(args.replicas)
         ]
@@ -1160,11 +1265,13 @@ def cmd_loadgen(args):
         groups, args.m, max_batch=args.server_batch,
         max_delay_s=args.server_delay_ms / 1e3,
         max_pending=args.max_pending,
+        probe_policy=probe_policy,
     )
     try:
         record = loadgen.run(
             server, schedule, code_bytes=args.code_bytes,
             seed=args.seed, warmup_rows=max(request_rows),
+            probe_policy=probe_policy,
         )
     finally:
         server.close()
@@ -1179,7 +1286,8 @@ def cmd_loadgen(args):
         "m": args.m,
         "shards": args.shards,
         "replicas": args.replicas,
-        "probes": args.probes,
+        "probes": probes_default,
+        "probe_policy": probe_policy,
     })
     if args.out:
         with open(args.out, "w") as f:

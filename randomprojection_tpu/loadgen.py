@@ -201,7 +201,8 @@ def slo_table(latencies_s: Sequence[float], *, rows: int = 0,
 
 
 def run(server, schedule, *, code_bytes: int, seed: int = 0,
-        warmup_rows: int = 0) -> dict:
+        warmup_rows: int = 0,
+        probe_policy: Optional[dict] = None) -> dict:
     """Drive ``server`` through ``schedule`` open-loop and return the
     ``topk_slo`` record (see module docstring).
 
@@ -210,6 +211,11 @@ def run(server, schedule, *, code_bytes: int, seed: int = 0,
     silently change arrival times); each request slices distinct rows
     so a device call cache cannot serve repeats.  ``warmup_rows > 0``
     issues one unmeasured blocking request first (compile warmup).
+
+    ``probe_policy`` (label → probes) is RECORDED per label in the SLO
+    table so mixed quality classes stay attributable — routing itself
+    lives in the server (``TopKServer(probe_policy=...)``); pass the
+    same dict to both.
     """
     total_rows = sum(r.rows for r in schedule)
     if total_rows == 0:
@@ -311,6 +317,9 @@ def run(server, schedule, *, code_bytes: int, seed: int = 0,
             lats, rows=rows_by_label.get(label, 0),
             rejects=rejects_by_label.get(label, 0),
         )
+        if probe_policy is not None:
+            # None = the server's default probes served this label
+            labels_out[label]["probes"] = probe_policy.get(label)
     n_rejects = sum(rejects_by_label.values())
     record = {
         "metric": "topk_slo",

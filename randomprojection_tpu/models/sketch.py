@@ -1287,9 +1287,37 @@ class TopKServer:
     def __init__(self, index: "SimHashIndex", m: int, *,
                  max_batch: int = 8192, max_delay_s: float = 0.002,
                  max_pending: int = 8192, name: str = "topk",
+                 probe_policy: Optional[dict] = None,
                  start: bool = True):
         if not isinstance(m, numbers.Integral) or m <= 0:
             raise ValueError(f"m must be a positive int, got {m!r}")
+        if probe_policy is not None:
+            # per-label probe classes (ISSUE 16): label → probes, keyed
+            # by the SANITIZED label (submit sanitizes before routing);
+            # 0 pins a label onto the exact path.  Requires an index
+            # whose query_topk takes ``probes`` (the LSH tier).
+            if not isinstance(probe_policy, dict):
+                raise ValueError(
+                    f"probe_policy must be a dict of label -> probes, "
+                    f"got {probe_policy!r}"
+                )
+            if not hasattr(index, "probes"):
+                raise ValueError(
+                    "probe_policy requires an LSH-tier index (its "
+                    "query_topk must accept probes=); got "
+                    f"{type(index).__name__}"
+                )
+            pol = {}
+            for k, v in probe_policy.items():
+                if (isinstance(v, bool)
+                        or not isinstance(v, numbers.Integral) or v < 0):
+                    raise ValueError(
+                        f"probe_policy[{k!r}] must be a non-negative "
+                        f"int, got {v!r}"
+                    )
+                pol[_metric_label(k)] = int(v)
+            probe_policy = pol
+        self.probe_policy = probe_policy
         if not isinstance(max_batch, numbers.Integral) or max_batch < 1:
             raise ValueError(
                 f"max_batch must be a positive int, got {max_batch!r}"
@@ -1477,7 +1505,21 @@ class TopKServer:
         return batch, False
 
     def _serve(self, batch) -> None:
-        """Run one coalesced dispatch and scatter results to futures."""
+        """Run one coalesced dispatch (per probe class when a
+        ``probe_policy`` is set — labels with different probe budgets
+        cannot share a candidate dispatch) and scatter results."""
+        if self.probe_policy is None:
+            self._serve_group(batch, None)
+            return
+        groups: dict = {}
+        for req in batch:
+            p = self.probe_policy.get(req[2]) if req[2] is not None else None
+            groups.setdefault(p, []).append(req)
+        for p, group in groups.items():
+            self._serve_group(group, p)
+
+    def _serve_group(self, batch, probes: Optional[int]) -> None:
+        """One coalesced dispatch for one probe class and its futures."""
         import time as _time
 
         from randomprojection_tpu.parallel.sharded import row_bucket
@@ -1497,7 +1539,15 @@ class TopKServer:
         index = self._pick_index()
         t0 = _time.perf_counter()
         try:
-            d, i = index.query_topk(arr, self.m, tile=pad_to)
+            # only pass probes when a policy resolved one: the base
+            # exact index has no probes kwarg, and the LSH default
+            # should keep serving unlabeled traffic
+            if probes is None:
+                d, i = index.query_topk(arr, self.m, tile=pad_to)
+            else:
+                d, i = index.query_topk(
+                    arr, self.m, tile=pad_to, probes=probes
+                )
         except BaseException as e:
             # the exception reaches every caller through its future, but
             # an unobserved future would swallow it silently — record the
@@ -1525,6 +1575,7 @@ class TopKServer:
                 EVENTS.SERVE_TOPK_BATCH, rows=int(n), padded=int(pad_to),
                 requests=len(batch), m=int(self.m),
                 wall_s=round(wall, 6),
+                **({} if probes is None else {"probes": int(probes)}),
             )
         self._batch_served(index, n, pad_to, len(batch), wall)
         reg = telemetry.registry()
@@ -1552,6 +1603,7 @@ class TopKServer:
                     label=label, rows=int(hi - lo), m=int(self.m),
                     queue_wait_s=round(queue_wait, 9),
                     serve_s=round(wall, 9), total_s=round(total, 9),
+                    **({} if probes is None else {"probes": int(probes)}),
                 )
             lo = hi
 

@@ -1,0 +1,407 @@
+"""Device-fused LSH candidate generation (ISSUE 16).
+
+r18's multi-probe tier made retrieval sublinear but left the probe half
+of every query on the host: band-key extraction, CSR bucket walks, the
+cross-band ``np.unique`` dedup and the candidate-id upload all run in
+numpy per query tile while the device idles until ``jnp.take`` + the
+r12 re-rank kernel fire.  This module moves the whole candidate
+pipeline into the device program — one jitted dispatch per query tile,
+zero per-tile host work:
+
+1. **Band keys on device** (``device_band_keys``) — the packed query
+   tile unpacks to bits and reduces to per-band keys with the identical
+   little-endian bit order as the host ``ann.lsh.band_keys`` (test-
+   pinned bit-equal), fused by XLA into the same program.
+2. **CSR probe walk** (``_probe_kernel``) — a Pallas kernel over the
+   device-resident banded CSR: per (query, band, probe) run it XORs the
+   precomputed probe mask into the band key, reads the bucket's
+   ``[start, end)`` run bounds from the VMEM-resident ``indptr``, and
+   streams the run's id block(s) HBM→VMEM through the revolving
+   two-slot ``pltpu.make_async_copy`` pattern (r12 discipline, RP07-
+   checked), packing survivors densely into a sentinel-initialized
+   candidate-slot buffer.  A run that would overflow the slot budget
+   ``cap`` is skipped and flags ``overflow`` — the ladder's post-hoc
+   budget rung.  Inactive queries (adaptive early-exit, pad rows)
+   contribute zero-length runs.
+3. **Sort-unique dedup + gather + re-rank** (``device_probe_topk``) —
+   the slot buffer sorts on device (``jnp.sort``; the int32-max
+   sentinel sorts past every real id), duplicates and tombstones become
+   dead rows, candidate code rows gather from the resident chunks, and
+   the r12 fused Hamming re-rank + running top-m merge scores the tile
+   — local positions map back to global ids on device, so the host
+   only ever copies back the final ``(dist, gid)`` planes plus the
+   tile's scalar stats.
+
+Sorting ascending before the re-rank preserves the documented
+(distance, lower-global-id) tie order: lower slot index IS lower global
+id among live candidates, and every duplicate/sentinel/tombstone slot
+is masked dead so it can never displace a live row.  At full probe
+coverage the slot buffer holds every live id of every band (the plan's
+``cap`` bound is exact there), which keeps the device path bit-
+identical to the host probe path and to ``topk_bruteforce`` — the
+``make ann-smoke`` parity gate.
+
+``plan_probe`` budgets the kernel's VMEM residents (the per-band
+``indptr`` is the dominant term — band layouts past ~2^16 buckets/band
+return no plan and the tier serves the host probe rung instead) and
+picks the query sub-tile ``tq`` and slot budget ``cap``; the caller
+must also hold an r12 ``plan_fused(tq, cap, n_bytes, m)`` for the
+fused re-rank leg.
+
+Interpreter mode (auto-selected off-TPU, same deny-list as
+``topk_kernels.interpret_default``) runs the identical kernel — DMAs,
+revolving slots, masked packing — under the Pallas interpreter so
+tier-1 exercises the whole device path on CPU.  Mosaic lowering of the
+dynamic-offset lane writes and scalar VMEM loads is untested on a real
+chip this round (no TPU on this box — see BASELINE.md r19 note); the
+structure follows the guide's supported patterns.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from randomprojection_tpu.ops.topk_kernels import (
+    TopkPlan,
+    _ceil_pow2,
+    fused_topk,
+    interpret_default,
+)
+
+__all__ = [
+    "ProbePlan",
+    "plan_probe",
+    "device_band_keys",
+    "probe_gather",
+    "device_probe_topk",
+    "interpret_default",
+]
+
+# Mosaic's scoped-VMEM limit and the measured temporary headroom — same
+# constants as ops/topk_kernels.py (kept local: the probe kernel
+# budgets an independent buffer set and must not couple its tuning).
+_VMEM_LIMIT = 16 << 20
+_VMEM_HEADROOM = 3 << 20
+
+_INT32_MAX = (1 << 31) - 1
+# empty-slot sentinel: sorts past every real candidate id, and is dead
+# by construction (>= any corpus size the int32 id space can hold)
+_SENTINEL_ID = _INT32_MAX
+
+# candidate-slot skew slack: ``cap`` covers SLACK× the expected
+# (average-bucket) gather so hot buckets don't trip the budget rung on
+# ordinary skew; genuinely dense tiles overflow and fall back, which is
+# the density ladder made structural
+_CAP_SLACK = 4
+# absolute slot ceiling — past this the slot buffer alone exceeds the
+# scoped-VMEM budget and the tile is host-probe territory anyway
+_CAP_CEILING = 1 << 22
+
+
+class ProbePlan(NamedTuple):
+    """A VMEM-feasible tiling for one device-probe shape.
+
+    ``tq`` query rows per dispatch (the device path clamps the serving
+    tile to this), ``cap`` pow2 candidate-slot budget per tile (the
+    pre-dedup gather bound — overflow falls back to the exact rung),
+    ``blk`` id rows per CSR-run DMA block (the revolving two-slot
+    transfer size)."""
+
+    tq: int
+    cap: int
+    blk: int
+
+
+def plan_probe(nq: int, rows: int, bands: int, band_bits: int,
+               n_probes: int, m: int) -> Optional[ProbePlan]:
+    """The largest VMEM-feasible ``(tq, cap, blk)`` for a device-probe
+    dispatch over ``nq`` queries against a ``rows``-id banded CSR, or
+    None when no tiling fits — the caller then serves the host probe
+    rung (r6 convention: classify, degrade, memoize, emit).
+
+    The budget: the per-band ``indptr`` plane (the dominant resident —
+    ``bands · (2^band_bits + 1)`` int32), the query band keys, probe
+    masks and active mask, the per-query count plane, the packed
+    candidate-slot buffer (``cap + blk`` — block writes round up to the
+    DMA block), two revolving DMA slots, and the Mosaic headroom, all
+    within the 16 MiB scoped limit.  ``cap`` itself is the density
+    ladder made structural: ``_CAP_SLACK×`` the average-bucket gather
+    expectation, exact (never overflowing) at full probe coverage,
+    floored at ``4·m`` so a feasible plan can always fill a result."""
+    if nq <= 0 or rows <= 0 or m <= 0 or n_probes <= 0:
+        return None
+    if bands < 1 or band_bits < 1:
+        return None
+    nb = 1 << band_bits
+    n_probes = min(int(n_probes), nb)
+    indptr_bytes = bands * (nb + 1) * 4
+    bucket = max(1, -(-rows // nb))  # ceil average bucket size
+    tq_cands = [t for t in (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+                if t <= max(_ceil_pow2(nq), 1)]
+    for tq in tq_cands:
+        expected = tq * bands * n_probes * bucket
+        cap_req = min(tq * bands * rows, _CAP_SLACK * expected)
+        cap = _ceil_pow2(max(cap_req, 4 * m, 128))
+        if cap > _CAP_CEILING:
+            continue
+        for blk in (512, 256, 128, 64):
+            usage = (
+                indptr_bytes
+                + bands * tq * 4            # query band keys
+                + _ceil_pow2(n_probes) * 4  # probe masks
+                + 2 * tq * 4                # active mask + count planes
+                + (cap + blk) * 4           # packed candidate slots
+                + 2 * blk * 4               # DMA double buffer
+                + _VMEM_HEADROOM
+            )
+            if usage <= _VMEM_LIMIT:
+                return ProbePlan(tq, cap, blk)
+    return None
+
+
+def device_band_keys(codes, bands: int, band_bits: int):
+    """Band keys of a packed uint8 code tile ON DEVICE: ``(bands, n)``
+    int32, key ``j`` of a row being its code bits ``[j·b, (j+1)·b)``
+    little-endian within each byte — bit-equal to the host
+    ``ann.lsh.band_keys`` (test-pinned), fused by XLA into the probe
+    dispatch so no key byte ever crosses the host boundary."""
+    b8 = codes.astype(jnp.int32)
+    bits = (b8[:, :, None] >> jnp.arange(8, dtype=jnp.int32)) & 1
+    bits = bits.reshape(codes.shape[0], -1)[:, : bands * band_bits]
+    w = jnp.int32(1) << jnp.arange(band_bits, dtype=jnp.int32)
+    keys = (bits.reshape(codes.shape[0], bands, band_bits)
+            * w[None, None, :]).sum(axis=2, dtype=jnp.int32)
+    return keys.T
+
+
+def _probe_kernel(qkeys_ref, masks_ref, active_ref, indptr_ref, ids_hbm,
+                  out_ref, cnt_ref, stat_ref, buf, sem, *, bands: int,
+                  n_probes: int, tq: int, cap: int, blk: int):
+    """Kernel body: walk every (query, band, probe) CSR run, packing
+    the gathered ids densely into the sentinel-initialized slot buffer.
+    Every run issues exactly one warm DMA plus guarded look-ahead
+    copies through the two revolving slots — skipped/overflowing runs
+    stream one fully-masked block so start/wait stay unconditional
+    (RP07 discipline; the masked lanes write sentinels ABOVE the write
+    cursor, which later runs overwrite or the dedup discards)."""
+    out_ref[:] = jnp.full((1, cap + blk), _SENTINEL_ID, jnp.int32)
+    cnt_ref[:] = jnp.zeros((1, tq), jnp.int32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
+
+    def run_step(t, carry):
+        wr, ovf = carry
+        q = t // (bands * n_probes)
+        j = (t // n_probes) % bands
+        p = t % n_probes
+        qk = pl.load(qkeys_ref, (pl.ds(j, 1), pl.ds(q, 1)))[0, 0]
+        mk = pl.load(masks_ref, (pl.ds(0, 1), pl.ds(p, 1)))[0, 0]
+        key = qk ^ mk
+        start = pl.load(indptr_ref, (pl.ds(j, 1), pl.ds(key, 1)))[0, 0]
+        end = pl.load(indptr_ref, (pl.ds(j, 1), pl.ds(key + 1, 1)))[0, 0]
+        act = pl.load(active_ref, (pl.ds(0, 1), pl.ds(q, 1)))[0, 0]
+        ln = jnp.where(act != 0, end - start, 0)
+        fits = wr + ln <= cap
+        do = fits & (ln > 0)
+        # attempted yield per query — the adaptive budget accounting
+        # counts what the probes FOUND even when the slot budget trips
+        prev = pl.load(cnt_ref, (pl.ds(0, 1), pl.ds(q, 1)))[0, 0]
+        pl.store(cnt_ref, (pl.ds(0, 1), pl.ds(q, 1)),
+                 jnp.reshape(prev + ln, (1, 1)))
+        nblk = jnp.where(do, (ln + blk - 1) // blk, 1)
+        ln_w = jnp.where(do, ln, 0)
+
+        def run_copy(k):
+            # ids_hbm is sentinel-padded by one block per band, so the
+            # last (ragged) block of a run reads past ``end`` but never
+            # past the pad — masked lanes replace the overread
+            return pltpu.make_async_copy(
+                ids_hbm.at[pl.ds(j, 1), pl.ds(start + k * blk, blk)],
+                buf.at[k % 2],
+                sem.at[k % 2],
+            )
+
+        run_copy(0).start()  # warm the pipeline (dummy block when idle)
+
+        def blk_step(k, _):
+            @pl.when(k + 1 < nblk)
+            def _():
+                run_copy(k + 1).start()
+
+            run_copy(k).wait()
+            rem = ln_w - k * blk
+            mb = jnp.where(lane < rem, buf[k % 2], _SENTINEL_ID)
+            pl.store(out_ref, (pl.ds(0, 1), pl.ds(wr + k * blk, blk)), mb)
+            return 0
+
+        jax.lax.fori_loop(0, nblk, blk_step, 0)
+        ovf = ovf | jnp.where((~fits) & (ln > 0), jnp.int32(1),
+                              jnp.int32(0))
+        return wr + ln_w, ovf
+
+    wr, ovf = jax.lax.fori_loop(
+        0, tq * bands * n_probes, run_step,
+        (jnp.int32(0), jnp.int32(0)),
+    )
+    stats = jnp.zeros((1, 8), jnp.int32)
+    stats = stats.at[0, 0].set(wr)
+    stats = stats.at[0, 1].set(ovf)
+    stat_ref[:] = stats
+
+
+def _probe_pallas(qkeys, masks, active, indptr, ids, *, plan: ProbePlan,
+                  bands: int, n_probes: int, interpret: bool):
+    """One probe-kernel launch: ``(slots (cap,), counts (tq,),
+    stats (8,))`` — stats[0] ids written, stats[1] overflow flag."""
+    tq, cap, blk = plan
+    out, cnt, stat = pl.pallas_call(
+        functools.partial(
+            _probe_kernel, bands=bands, n_probes=n_probes, tq=tq,
+            cap=cap, blk=blk,
+        ),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # qkeys (bands, tq)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # masks (1, P)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # active (1, tq)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # indptr (bands, nb+1)
+            pl.BlockSpec(memory_space=pltpu.ANY),   # ids (bands, n+blk)
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, cap + blk), jnp.int32),
+            jax.ShapeDtypeStruct((1, tq), jnp.int32),
+            jax.ShapeDtypeStruct((1, 8), jnp.int32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, 1, blk), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(qkeys, masks, active, indptr, ids)
+    return out[0, :cap], cnt[0], stat[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("plan", "bands", "n_probes", "interpret"),
+)
+def _probe_gather_impl(qkeys, masks, active, indptr, ids, *,
+                       plan: ProbePlan, bands: int, n_probes: int,
+                       interpret: bool):
+    return _probe_pallas(
+        qkeys, masks, active, indptr, ids, plan=plan, bands=bands,
+        n_probes=n_probes, interpret=interpret,
+    )
+
+
+def probe_gather(qkeys, masks, active, indptr, ids, *, plan: ProbePlan,
+                 interpret: Optional[bool] = None):
+    """Probe-walk one query tile against a device-resident banded CSR.
+
+    ``qkeys`` (bands, tq) int32 band keys, ``masks`` (1, P) int32 XOR
+    probe masks, ``active`` (1, tq) int32 (0 = skip the query's runs),
+    ``indptr`` (bands, 2^band_bits + 1) int32 clamped offsets, ``ids``
+    (bands, n + blk) int32 with the trailing block sentinel-padded.
+    Returns ``(slots, counts, stats)``: the densely-packed pre-dedup
+    candidate ids (``cap``, sentinel = int32 max beyond the write
+    cursor), per-query attempted yields, and ``[written, overflow, ...]``
+    scalars.  Exposed for unit tests; serving fuses this into
+    ``device_probe_topk``."""
+    if interpret is None:
+        interpret = interpret_default()
+    return _probe_gather_impl(
+        qkeys, masks, active, indptr, ids, plan=plan,
+        bands=int(qkeys.shape[0]), n_probes=int(masks.shape[1]),
+        interpret=bool(interpret),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "pplan", "fplan", "bands", "band_bits", "m", "row0s", "rows",
+        "interpret",
+    ),
+)
+def _device_probe_topk_impl(q, masks, active, indptr, ids, dead_full,
+                            chunks, *, pplan: ProbePlan,
+                            fplan: TopkPlan, bands: int, band_bits: int,
+                            m: int, row0s, rows, interpret: bool):
+    tq, cap, blk = pplan
+    n_total = int(dead_full.shape[0])
+    qkeys = device_band_keys(q, bands, band_bits)
+    slots, cnt, stat = _probe_pallas(
+        qkeys, masks, active, indptr, ids, plan=pplan, bands=bands,
+        n_probes=int(masks.shape[1]), interpret=interpret,
+    )
+    # sort-unique dedup: ascending slot order restores ascending global
+    # id order (the tie-break contract), sentinels sort last, and every
+    # duplicate / sentinel / tombstoned slot goes dead so it can never
+    # displace a live candidate in the re-rank
+    s = jnp.sort(slots)
+    dup = jnp.concatenate(
+        [jnp.zeros((1,), jnp.bool_), s[1:] == s[:-1]]
+    )
+    invalid = (s >= jnp.int32(n_total)) | dup
+    sc = jnp.clip(s, 0, max(n_total - 1, 0))
+    dead_c = invalid | (dead_full[sc] != 0)
+    n_live_cand = jnp.sum(~dead_c).astype(jnp.int32)
+    # gather candidate code rows from the resident chunks — each live
+    # id lands in exactly one chunk's REAL row range (chunk arrays pad
+    # trailing rows; ``rows`` carries the real counts); dead slots keep
+    # zeros
+    g = jnp.zeros((cap, q.shape[1]), jnp.uint8)
+    for row0, nc, arr in zip(row0s, rows, chunks):
+        inc = (sc >= row0) & (sc < row0 + nc)
+        loc = jnp.clip(sc - row0, 0, max(nc - 1, 0))
+        g = jnp.where(inc[:, None], arr[loc], g)
+    d, idx = fused_topk(
+        q, g, cap, m, dead=dead_c.astype(jnp.uint8), plan=fplan,
+        interpret=interpret,
+    )
+    gid = jnp.where(
+        idx >= cap, jnp.int32(_INT32_MAX),
+        s[jnp.clip(idx, 0, cap - 1)],
+    )
+    stat = stat.at[2].set(n_live_cand)
+    return d, gid, stat, cnt
+
+
+def device_probe_topk(q, masks, active, indptr, ids, dead_full, chunks,
+                      row0s, rows, m: int, *, pplan: ProbePlan,
+                      fplan: TopkPlan,
+                      band_bits: int,
+                      interpret: Optional[bool] = None):
+    """The fused probe → dedup → gather → re-rank program for one query
+    tile: ONE device dispatch, zero per-tile host work.
+
+    ``q`` (tq, n_bytes) uint8 padded query tile, ``masks`` (1, P)
+    int32, ``active`` (1, tq) int32, ``indptr``/``ids`` the device-
+    resident CSR (see ``probe_gather``), ``dead_full`` (n_total,) uint8
+    full tombstone vector, ``chunks`` the resident code chunk arrays
+    (possibly row-padded) with ``row0s``/``rows`` their static global
+    row offsets and REAL row counts.  Returns device arrays ``(dist
+    (tq, m), gid (tq, m), stats (8,), counts (tq,))`` — ``stats =
+    [gathered, overflow, live_candidates, 0...]``; the caller applies
+    the post-hoc fallback ladder (starved / dense / budget overflow)
+    before trusting the tile."""
+    if interpret is None:
+        interpret = interpret_default()
+    return _device_probe_topk_impl(
+        q, masks, active, indptr, ids, dead_full, tuple(chunks),
+        pplan=pplan, fplan=fplan, bands=int(indptr.shape[0]),
+        band_bits=int(band_bits), m=int(m),
+        row0s=tuple(int(r) for r in row0s),
+        rows=tuple(int(r) for r in rows),
+        interpret=bool(interpret),
+    )

@@ -45,7 +45,8 @@ class ShardedTopKServer(TopKServer):
 
     def __init__(self, replicas, m: int, *, max_batch: int = 8192,
                  max_delay_s: float = 0.002, max_pending: int = 8192,
-                 name: str = "sharded", start: bool = True):
+                 name: str = "sharded", probe_policy=None,
+                 start: bool = True):
         if not isinstance(replicas, (list, tuple)):
             replicas = [replicas]
         replicas = list(replicas)
@@ -68,6 +69,17 @@ class ShardedTopKServer(TopKServer):
                     "serve identical corpora or results become "
                     "routing-dependent"
                 )
+        if probe_policy is not None:
+            # the policy routes through whichever replica round-robin
+            # picks, so EVERY replica must carry the probes kwarg —
+            # the base constructor only sees replica 0
+            for r, rep in enumerate(replicas):
+                if not hasattr(rep, "probes"):
+                    raise ValueError(
+                        f"probe_policy requires LSH-tier replicas (its "
+                        f"query_topk must accept probes=); replica {r} "
+                        f"is {type(rep).__name__}"
+                    )
         self.replicas = replicas
         self._rr = 0  # dispatcher-thread-private round-robin cursor
         # the per-replica tallies cross threads (dispatcher writes,
@@ -77,7 +89,8 @@ class ShardedTopKServer(TopKServer):
         self._route_lock = threading.Lock()
         super().__init__(
             first, m, max_batch=max_batch, max_delay_s=max_delay_s,
-            max_pending=max_pending, name=name, start=start,
+            max_pending=max_pending, name=name,
+            probe_policy=probe_policy, start=start,
         )
 
     @property

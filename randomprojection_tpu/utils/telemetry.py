@@ -235,6 +235,13 @@ class EVENTS:
     INDEX_LSH_DISPATCH = "index.lsh.dispatch"
     INDEX_LSH_FALLBACK = "index.lsh.fallback"
     INDEX_LSH_BUILD = "index.lsh.build"
+    # device-fused candidate generation (ISSUE 16): per-tile fused
+    # probe → gather → re-rank dispatch record, device-CSR mirror
+    # (re-)uploads, and the adaptive per-query probing round summary
+    # (probes-used, early exits, budget stops).
+    INDEX_LSH_DEVICE_DISPATCH = "index.lsh.device_dispatch"
+    INDEX_LSH_DEVICE_UPLOAD = "index.lsh.device_upload"
+    INDEX_LSH_ADAPTIVE = "index.lsh.adaptive"
 
     # runtime-completed name families.  ``*_FAMILY`` constants are the
     # prefixes callers build on (today: the per-kernel-path hash counter

@@ -181,6 +181,16 @@ def build_report(path: str) -> dict:
     lsh_fallbacks: dict = {}
     lsh_builds = 0
     lsh_build_rows = 0
+    # device-fused probe tier (ISSUE 16)
+    lsh_dev_tiles = 0
+    lsh_dev_uploads = 0
+    lsh_dev_upload_bytes = 0
+    lsh_adaptive_tiles = 0
+    lsh_adaptive_queries = 0
+    lsh_adaptive_rounds = 0
+    lsh_adaptive_probes_sum = 0.0
+    lsh_adaptive_early = 0
+    lsh_adaptive_budget = 0
 
     def _lat_observe(key: str, seconds: float) -> None:
         h = lat_hists.setdefault(key, {"sum": 0.0, "count": 0,
@@ -317,6 +327,40 @@ def build_report(path: str) -> dict:
             )
             lsh_candidates += e.get("candidates", 0) or 0
             lsh_frac_sum += e.get("candidate_fraction", 0.0) or 0.0
+        elif name == EVENTS.INDEX_LSH_DEVICE_DISPATCH:
+            # device-fused tile (ISSUE 16): same tile accounting as the
+            # host probe path — the split between the two shows how
+            # much of retrieval runs without the host CSR-walk hop
+            lsh_tiles += 1
+            lsh_dev_tiles += 1
+            lsh_queries += e.get("queries", 0) or 0
+            lsh_probes += (
+                (e.get("queries", 0) or 0)
+                * (e.get("probes", 0) or 0)
+                * (e.get("bands", 0) or 0)
+            )
+            lsh_candidates += e.get("candidates", 0) or 0
+            lsh_frac_sum += e.get("candidate_fraction", 0.0) or 0.0
+        elif name == EVENTS.INDEX_LSH_ADAPTIVE:
+            # adaptive tile: counts as a device tile; the per-query
+            # probe escalation summary aggregates separately
+            lsh_tiles += 1
+            lsh_dev_tiles += 1
+            lsh_queries += e.get("queries", 0) or 0
+            lsh_candidates += e.get("candidates", 0) or 0
+            lsh_frac_sum += e.get("candidate_fraction", 0.0) or 0.0
+            lsh_adaptive_tiles += 1
+            lsh_adaptive_queries += e.get("queries", 0) or 0
+            lsh_adaptive_rounds += e.get("rounds", 0) or 0
+            lsh_adaptive_probes_sum += (
+                (e.get("probes_used_mean", 0.0) or 0.0)
+                * (e.get("queries", 0) or 0)
+            )
+            lsh_adaptive_early += e.get("early_exits", 0) or 0
+            lsh_adaptive_budget += e.get("budget_stops", 0) or 0
+        elif name == EVENTS.INDEX_LSH_DEVICE_UPLOAD:
+            lsh_dev_uploads += 1
+            lsh_dev_upload_bytes += e.get("bytes", 0) or 0
         elif name == EVENTS.INDEX_LSH_FALLBACK:
             reason = str(e.get("reason") or "unknown")
             lsh_fallbacks[reason] = lsh_fallbacks.get(reason, 0) + 1
@@ -470,6 +514,26 @@ def build_report(path: str) -> dict:
                 ),
                 "builds": lsh_builds,
                 "build_rows": lsh_build_rows,
+                "device_tiles": lsh_dev_tiles,
+                "device_uploads": lsh_dev_uploads,
+                "device_upload_bytes": lsh_dev_upload_bytes,
+                "adaptive": (
+                    {
+                        "tiles": lsh_adaptive_tiles,
+                        "rounds": lsh_adaptive_rounds,
+                        "probes_used_mean": (
+                            round(
+                                lsh_adaptive_probes_sum
+                                / max(lsh_adaptive_queries, 1),
+                                3,
+                            )
+                        ),
+                        "early_exits": lsh_adaptive_early,
+                        "budget_stops": lsh_adaptive_budget,
+                    }
+                    if lsh_adaptive_tiles
+                    else None
+                ),
             }
             if (lsh_tiles or lsh_fallbacks or lsh_builds)
             else None
@@ -608,6 +672,20 @@ def render_report(report: dict) -> str:
                 if frac is not None else ""
             )
         )
+        if cg.get("device_tiles"):
+            lines.append(
+                f"  device-fused probe tiles: {cg['device_tiles']} "
+                f"({cg.get('device_uploads', 0)} CSR upload(s), "
+                f"{cg.get('device_upload_bytes', 0)} bytes)"
+            )
+        ad = cg.get("adaptive")
+        if ad:
+            lines.append(
+                f"  adaptive probing: {ad['tiles']} tile(s), "
+                f"{ad['rounds']} round(s), mean {ad['probes_used_mean']} "
+                f"probes/query, {ad['early_exits']} early exit(s), "
+                f"{ad['budget_stops']} budget stop(s)"
+            )
         fb = cg.get("fallbacks") or {}
         if fb:
             detail = ", ".join(f"{k} {v}" for k, v in fb.items())

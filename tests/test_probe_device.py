@@ -1,0 +1,281 @@
+"""Device-fused candidate generation (ISSUE 16): the on-device probe →
+gather → re-rank path, adaptive per-query probing, the candidate-budget
+knob, per-label probe policies through the serving tier, and the
+device-side telemetry/doctor rows.
+
+The interpreter-run device dispatches (`probe_path="device"` on CPU) are
+marked ``slow``: each one pads queries to the plan's tile and walks the
+CSR under the Pallas interpreter, which costs tens of seconds — the
+budgeted tier-1 run keeps the host-side contract tests, and `make
+ann-smoke` (in `make verify` and CI) carries the bit-parity gate at toy
+shapes.  Everything here that dispatches on-device shares ONE shape
+family (8-byte codes, bands=4/band_bits=4, m=5) so interpreter programs
+compile once per session."""
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu.ann import (
+    BandedBuckets,
+    BandPlan,
+    LSHShardedSimHashIndex,
+    LSHSimHashIndex,
+    probe_masks,
+)
+from randomprojection_tpu.models import sketch as sk
+from randomprojection_tpu.ops import probe_kernels
+from randomprojection_tpu.utils import telemetry
+
+# the shared device-shape family (see module docstring): 16 buckets per
+# band keeps full coverage (and the adaptive level ladder) cheap under
+# the interpreter
+N, NB, M, FULL = 400, 8, 5, 1 << 4
+BANDS = dict(bands=4, band_bits=4)
+
+
+def _rand_codes(n, nbytes, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(n, nbytes), dtype=np.uint8
+    )
+
+
+def _corpus(seed=0):
+    return _rand_codes(N, NB, seed=seed)
+
+
+def _queries(seed=100):
+    return _rand_codes(8, NB, seed=seed)
+
+
+# -- host-side contracts (fast, tier-1) --------------------------------------
+
+
+def test_probe_path_knob_validation_and_resolution():
+    codes = _corpus(seed=1)
+    with pytest.raises(ValueError, match="probe_path"):
+        LSHSimHashIndex(codes, **BANDS, probe_path="bogus")
+    idx = LSHSimHashIndex(codes, **BANDS)
+    assert idx.probe_path == "auto"
+    with pytest.raises(ValueError, match="probe_path"):
+        idx.query_topk(_queries(), M, probe_path="bogus")
+    # "auto" follows the kernels' interpret default: host under the
+    # interpreter (this CPU run), device on chips
+    assert idx._lsh_probe_device("host") is False
+    assert idx._lsh_probe_device("device") is True
+    assert idx._lsh_probe_device("auto") is (
+        not probe_kernels.interpret_default()
+    )
+    # None = the constructor default
+    assert idx._lsh_probe_device(None) == idx._lsh_probe_device("auto")
+
+
+def test_adaptive_and_budget_knob_validation():
+    codes = _corpus(seed=2)
+    # bools must not pass integer validation (True == 1 would silently
+    # serve a 1-probe/1-candidate tier)
+    with pytest.raises(ValueError, match="probes"):
+        LSHSimHashIndex(codes, **BANDS, probes=True)
+    with pytest.raises(ValueError, match="candidate_budget"):
+        LSHSimHashIndex(codes, **BANDS, candidate_budget=True)
+    with pytest.raises(ValueError, match="candidate_budget"):
+        LSHSimHashIndex(codes, **BANDS, candidate_budget=0)
+    with pytest.raises(ValueError, match="candidate_budget"):
+        LSHSimHashIndex(codes, **BANDS, candidate_budget=-3)
+    idx = LSHSimHashIndex(codes, **BANDS, adaptive=True,
+                          candidate_budget=64)
+    assert idx.adaptive is True and idx.candidate_budget == 64
+    # per-call probes: bool and negatives rejected (same validator)
+    for bad in (True, False, -1):
+        with pytest.raises(ValueError, match="probes"):
+            idx.query_topk(_queries(), M, probes=bad)
+    with pytest.raises(ValueError, match="candidate_budget"):
+        idx.query_topk(_queries(), M, candidate_budget=True)
+
+
+def test_candidates_all_empty_buckets():
+    # a query whose probed buckets are ALL empty must yield an empty
+    # (not crashing, not None) candidate set — the starved rung's input
+    plan = BandPlan(16, bands=2, band_bits=8)
+    b = BandedBuckets(plan)
+    b.add(np.zeros((5, 2), np.uint8))  # everything lands in bucket 0
+    qkeys = np.full((2, 3), 200, np.uint32)  # probe far-away buckets
+    cand, gathered = b.candidates(qkeys, probe_masks(8, 2))
+    assert cand.size == 0 and cand.dtype == np.int32
+    assert gathered == 0
+
+
+def test_probes_clamp_past_bucket_space():
+    # probes beyond 2^band_bits clamp to full coverage instead of
+    # probing phantom buckets — answers identical to the exact ceiling
+    codes = _corpus(seed=3)
+    q = _queries(seed=4)
+    idx = LSHSimHashIndex(codes, **BANDS, fallback_density=1.0)
+    d1, i1 = idx.query_topk(q, M, probes=FULL)
+    d2, i2 = idx.query_topk(q, M, probes=10**6)
+    assert np.array_equal(d1, d2) and np.array_equal(i1, i2)
+
+
+def test_candidate_fraction_uses_live_rows():
+    """Majority-tombstoned regression (ISSUE 16 satellite): the
+    candidate-fraction gauge and fallback density must divide by LIVE
+    rows.  At full coverage over a 2/3-tombstoned corpus the union is
+    exactly the live set — the gauge must read 1.0, not live/total."""
+    codes = _corpus(seed=5)
+    idx = LSHSimHashIndex(codes, **BANDS, fallback_density=1.0)
+    idx.delete(np.arange(0, 267))  # 267 of 400 dead
+    assert idx.n_live == N - 267
+    q = _queries(seed=6)
+    d, i = idx.query_topk(q, M, probes=FULL)
+    reg = telemetry.registry()
+    assert reg.gauge("index.lsh.candidate_fraction")["last"] == (
+        pytest.approx(1.0)
+    )
+    # and the answers are the masked brute force (the tier still serves)
+    D = sk.pairwise_hamming(q, codes).astype(np.int64)
+    D[:, :267] = NB * 8 + 1
+    rd, ri = sk._host_topk_select(D, M)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri)
+
+
+def test_probe_policy_validation_and_serving():
+    from randomprojection_tpu.serving import ShardedTopKServer
+
+    codes = _corpus(seed=7)
+    q = _queries(seed=8)
+    idx = LSHSimHashIndex(codes, **BANDS, probes=2, fallback_density=1.0)
+    plain = sk.SimHashIndex(codes)
+    # policy requires an LSH-tier index and integer (non-bool) probes
+    with pytest.raises(ValueError, match="probe_policy"):
+        sk.TopKServer(plain, M, probe_policy={"a": 2}, start=False)
+    with pytest.raises(ValueError, match="non-negative int"):
+        sk.TopKServer(idx, M, probe_policy={"a": True}, start=False)
+    with pytest.raises(ValueError, match="non-negative int"):
+        sk.TopKServer(idx, M, probe_policy={"a": -1}, start=False)
+    with pytest.raises(ValueError, match="probe_policy"):
+        sk.TopKServer(idx, M, probe_policy=[("a", 2)], start=False)
+    # every replica must carry the probes surface, not just replica 0
+    with pytest.raises(ValueError, match="replica 1"):
+        ShardedTopKServer([idx, plain], M, probe_policy={"a": 2},
+                          start=False)
+    # routing: "exact" pins probes=0 (brute-force parity), "bulk" rides
+    # the label's own probe count, unlisted labels take the tier default
+    rd, ri = sk.topk_bruteforce(q, codes, M)
+    with sk.TopKServer(idx, M, max_delay_s=0.0,
+                       probe_policy={"exact": 0, "bulk": FULL}) as srv:
+        d0, i0 = srv.query(q, label="exact")
+        d1, i1 = srv.query(q, label="bulk")
+        d2, i2 = srv.query(q, label="other")
+    assert np.array_equal(d0, rd) and np.array_equal(i0, ri)
+    assert np.array_equal(d1, rd) and np.array_equal(i1, ri)  # full = exact
+    assert d2.shape == (len(q), M)  # tier default (probes=2) serves
+
+
+def test_plan_probe_shapes_and_clamp():
+    # the planner clamps the probe count to the bucket space and refuses
+    # (None) only when even the smallest tile cannot fit — at toy shapes
+    # it must return a plan whose tile covers the queries
+    pl = probe_kernels.plan_probe(8, N, 4, 4, 10**6, M)
+    assert pl is not None
+    assert pl.tq >= 8 and pl.cap >= 4 * M
+    # a degenerate giant shape may legitimately return None, but must
+    # not raise
+    probe_kernels.plan_probe(1 << 20, 1 << 30, 64, 16, 1 << 16, 4096)
+
+
+# -- interpreter-run device dispatches (slow; ann-smoke carries the
+# tier-1 parity gate) ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_device_path_parity_fixed_probes():
+    codes = _corpus(seed=9)
+    q = _queries(seed=10)
+    idx = LSHSimHashIndex(codes[:300], **BANDS, fallback_density=1.0,
+                          probe_path="device")
+    idx.add(codes[300:])             # second chunk
+    idx.delete(np.arange(280, 320))  # tombstones across the seam
+    D = sk.pairwise_hamming(q, codes).astype(np.int64)
+    D[:, 280:320] = NB * 8 + 1
+    rd, ri = sk._host_topk_select(D, M)
+    d, i = idx.query_topk(q, M, probes=FULL)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri)
+    # partial probes: device == host, bit for bit
+    hd, hi = idx.query_topk(q, M, probes=3, probe_path="host")
+    dd, di = idx.query_topk(q, M, probes=3)
+    assert np.array_equal(dd, hd) and np.array_equal(di, hi)
+    st = idx.lsh_stats()
+    assert st["device_dispatches"] >= 2 and st["device_uploads"] >= 1
+
+
+@pytest.mark.slow
+def test_adaptive_full_ceiling_matches_brute_and_budget_monotone():
+    codes = _corpus(seed=11)
+    q = _queries(seed=12)
+    rd, ri = sk.topk_bruteforce(q, codes, M)
+    idx = LSHSimHashIndex(codes, **BANDS, fallback_density=1.0,
+                          probe_path="device", adaptive=True)
+    # no budget, full ceiling: the early-exit bound is PROVEN, so the
+    # adaptive path is exactly brute force
+    d, i = idx.query_topk(q, M, probes=FULL)
+    assert np.array_equal(d, rd) and np.array_equal(i, ri)
+    # recall is monotone in the candidate budget (each budget's scanned
+    # set is a superset of every smaller budget's)
+    prev = -1.0
+    for budget in (M, 64, 10**9):
+        d, i = idx.query_topk(q, M, probes=FULL, candidate_budget=budget)
+        recall = sum(
+            np.intersect1d(a, b).size for a, b in zip(i, ri)
+        ) / ri.size
+        assert recall >= prev
+        prev = recall
+    assert prev == 1.0  # an uncapped budget degenerates to the proof
+    st = idx.lsh_stats()
+    assert st["adaptive_tiles"] >= 4
+
+
+@pytest.mark.slow
+def test_device_events_doctor_rows(tmp_path):
+    from randomprojection_tpu.utils import trace_report
+
+    codes = _corpus(seed=13)
+    q = _queries(seed=14)
+    tel = str(tmp_path / "dev.jsonl")
+    telemetry.configure(tel)
+    try:
+        idx = LSHSimHashIndex(codes, **BANDS, fallback_density=1.0,
+                              probe_path="device")
+        idx.query_topk(q, M, probes=2)
+        idx.query_topk(q, M, probes=2, adaptive=True)
+    finally:
+        telemetry.shutdown()
+    names = [e["event"] for e in telemetry.read_events(tel)]
+    assert "index.lsh.device_upload" in names
+    assert "index.lsh.device_dispatch" in names
+    assert "index.lsh.adaptive" in names
+    report = trace_report.build_report(tel)
+    cg = report["candidate_generation"]
+    assert cg["device_tiles"] >= 2
+    assert cg["device_uploads"] >= 1 and cg["device_upload_bytes"] > 0
+    assert cg["adaptive"]["tiles"] >= 1
+    assert cg["adaptive"]["probes_used_mean"] > 0
+    assert not report["unregistered_events"]
+    text = trace_report.render_report(report)
+    assert "device-fused probe tiles" in text
+    assert "adaptive probing" in text
+
+
+@pytest.mark.slow
+def test_sharded_device_path_parity():
+    codes = _corpus(seed=15)
+    q = _queries(seed=16)
+    sh = LSHShardedSimHashIndex(codes, n_shards=4, **BANDS,
+                                fallback_density=1.0,
+                                probe_path="device")
+    dead = np.arange(90, 210)  # spans shard boundaries
+    sh.delete(dead)
+    D = sk.pairwise_hamming(q, codes).astype(np.int64)
+    D[:, dead] = NB * 8 + 1
+    rd, ri = sk._host_topk_select(D, M)
+    d, i = sh.query_topk(q, M, probes=FULL)
+    assert np.array_equal(d, rd)
+    assert np.array_equal(i, ri.astype(np.int64))
