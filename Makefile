@@ -65,16 +65,24 @@
 #                      quantile summary lines and a nonzero span-derived
 #                      live gauge (spans flowed through the in-process
 #                      subscriber with no JSONL file involved)
+#   make health-smoke  health plane (ISSUE r20): a real loadgen overload
+#                      fires the SLO burn-rate detector and clears on
+#                      recovery (GET /health 503→200, firing+cleared
+#                      events on the JSONL), an induced stall trips the
+#                      watchdog inside its timeout and dumps the flight
+#                      recorder, and a SIGTERM'd stream-bench leaves a
+#                      postmortem `doctor --postmortem` renders with the
+#                      last-active stage
 
 SHELL := /bin/bash
 PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
 .PHONY: verify lint lint-ci tier1 kernel-smoke transform-smoke shard-smoke \
-        ann-smoke recover-smoke doctor-smoke live-smoke
+        ann-smoke recover-smoke doctor-smoke live-smoke health-smoke
 
 verify: lint lint-ci kernel-smoke transform-smoke shard-smoke ann-smoke \
-        recover-smoke live-smoke tier1 doctor-smoke
+        recover-smoke live-smoke health-smoke tier1 doctor-smoke
 
 lint:
 	$(PYTHON) -m randomprojection_tpu lint
@@ -150,6 +158,9 @@ tier1:
 
 live-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m randomprojection_tpu.utils.live_smoke
+
+health-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m randomprojection_tpu.utils.health_smoke
 
 doctor-smoke:
 	rm -rf $(SMOKE_DIR) && mkdir -p $(SMOKE_DIR)

@@ -90,6 +90,7 @@ def main():
     )
     from randomprojection_tpu.parallel import distributed
     from randomprojection_tpu.utils import (
+        health,
         metrics_server,
         observability,
         telemetry,
@@ -112,6 +113,7 @@ def main():
         ("`randomprojection_tpu.utils.observability`", observability),
         ("`randomprojection_tpu.utils.telemetry`", telemetry),
         ("`randomprojection_tpu.utils.trace_report`", trace_report),
+        ("`randomprojection_tpu.utils.health`", health),
         ("`randomprojection_tpu.utils.metrics_server`", metrics_server),
         ("`randomprojection_tpu.loadgen`", loadgen),
         ("`randomprojection_tpu.ann`", ann),

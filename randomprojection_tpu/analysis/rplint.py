@@ -212,6 +212,10 @@ HOT_MODULES = (
     # r19 device-fused probe path: the probe→gather→re-rank kernels run
     # per serving tile — a host sync here IS the host hop they remove
     "ops/probe_kernels.py",
+    # r20 health plane: the engine's event fold + tick loop run for the
+    # whole process lifetime beside the serving path — a host sync or
+    # swallowed error there silently blinds every detector
+    "utils/health.py",
 )
 # RP06: modules on the pipeline/serving path where a swallowed error
 # strands a stream, a future, or a telemetry file
@@ -251,6 +255,10 @@ CONCURRENCY_MODULES = (
     # lock are all born under RP10/RP11
     "utils/metrics_server.py",
     "loadgen.py",
+    # r20 health plane: the engine lock is taken by both the subscriber
+    # dispatch thread (event fold) and the tick thread (evaluate) — the
+    # emit-outside-lock contract is exactly what RP10/RP11 police
+    "utils/health.py",
 )
 # RP05: Generator-construction surface of np.random that stays legal
 RNG_FACTORY_OK = frozenset(

@@ -132,6 +132,30 @@ def _add_observability(p):
                         "of span walls + time-weighted queue depth fed "
                         "by an in-process telemetry subscriber; poll it "
                         "with 'doctor --live HOST:PORT'")
+    p.add_argument("--health", nargs="?", const="", default=None,
+                   metavar="SPEC",
+                   help="run the health plane (utils/health.py) for the "
+                        "duration of the command: SLO burn-rate, stall-"
+                        "watchdog, queue-pinning and degraded-spike "
+                        "detectors over the live event stream, emitting "
+                        "firing/cleared health.* events and (with "
+                        "--metrics-port) answering GET /health (200 ok / "
+                        "503 while a critical detector fires).  SPEC sets "
+                        "latency targets and tuning, comma-separated: a "
+                        "bare number = default p99 target in ms, "
+                        "'label=ms' = per-label target, and the reserved "
+                        "keys budget/fast/slow/fire/clear/stall/tick "
+                        "tune windows and thresholds (e.g. "
+                        "'25,tenant-a=10,fast=2,slow=10').  No SPEC = "
+                        "no latency targets; the non-SLO detectors still "
+                        "run")
+    p.add_argument("--flight-dump", default=None, metavar="PATH",
+                   help="keep an always-on in-memory flight recorder "
+                        "(ring of the last 2048 events/spans) and dump "
+                        "it atomically to PATH as a self-describing "
+                        "postmortem JSON on SIGTERM/SIGABRT, unhandled "
+                        "exception, or stall-watchdog trip — analyze "
+                        "with 'doctor --postmortem PATH'")
 
 
 def _positive_int(v: str) -> int:
@@ -240,8 +264,15 @@ def build_parser():
                    help="poll the live metrics endpoint a --metrics-port "
                         "run is serving and render a refreshing terminal "
                         "view: queue depths, rolling per-stage span "
-                        "walls, serve-latency quantiles, degraded-"
-                        "counter rates")
+                        "walls, serve-latency quantiles, active health "
+                        "verdicts, degraded-counter rates (including "
+                        "per-subscriber drop rates)")
+    q.add_argument("--postmortem", default=None, metavar="DUMP",
+                   help="render a flight-recorder dump (--flight-dump "
+                        "PATH of a crashed/killed run) instead of a "
+                        "telemetry file: the final seconds — last-known "
+                        "per-stage activity, spans in flight at death, "
+                        "detectors firing at death, counter snapshot")
     q.add_argument("--interval", type=float, default=1.0,
                    help="--live poll interval in seconds")
     q.add_argument("--iterations", type=int, default=0, metavar="N",
@@ -470,6 +501,13 @@ def build_parser():
                    help="submit-queue bound (requests); beyond it "
                         "submissions are shed and counted as rejects")
     q.add_argument("--seed", type=int, default=0)
+    q.add_argument("--settle", type=float, default=0.0, metavar="SEC",
+                   help="keep the process (and its --health/"
+                        "--metrics-port planes) alive this long after "
+                        "the drain — the recovery window in which a "
+                        "fired SLO burn-rate detector clears and "
+                        "GET /health flips back to 200 (the health-"
+                        "smoke watches exactly this)")
     q.add_argument("--out", default=None, metavar="PATH",
                    help="also write the topk_slo record (one JSON "
                         "object) to this file — the bench artifact "
@@ -797,9 +835,33 @@ def cmd_doctor(args):
 
     if getattr(args, "live", None):
         return _cmd_doctor_live(args)
+    if getattr(args, "postmortem", None):
+        from randomprojection_tpu.utils.trace_report import (
+            build_postmortem,
+            render_postmortem,
+        )
+
+        if not os.path.exists(args.postmortem):
+            raise SystemExit(
+                f"no such flight-recorder dump: {args.postmortem}"
+            )
+        try:
+            with open(args.postmortem) as f:
+                dump = json.load(f)
+            pm = build_postmortem(dump)
+        except (ValueError, KeyError, TypeError) as e:
+            raise SystemExit(
+                f"unreadable flight-recorder dump {args.postmortem}: {e}"
+            )
+        if args.json:
+            print(json.dumps(pm))
+        else:
+            print(render_postmortem(pm), end="")
+        return
     if not args.telemetry:
         raise SystemExit(
-            "doctor wants a TELEMETRY_JSONL file (or --live HOST:PORT)"
+            "doctor wants a TELEMETRY_JSONL file, --postmortem DUMP, "
+            "or --live HOST:PORT"
         )
     if not os.path.exists(args.telemetry):
         raise SystemExit(f"no such telemetry file: {args.telemetry}")
@@ -1289,6 +1351,24 @@ def cmd_loadgen(args):
         "probes": probes_default,
         "probe_policy": probe_policy,
     })
+    if getattr(args, "health", None) is not None:
+        # the SAME spec the live burn-rate detector grades against rides
+        # in the record (r20): per-label targets + default, so post-hoc
+        # analysis and the live verdicts share one contract
+        from randomprojection_tpu.utils import health
+
+        spec = health.parse_slo_spec(args.health)
+        record["slo_targets"] = {
+            "default_ms": spec["default_ms"],
+            "labels": spec["labels"],
+            "spec": args.health,
+        }
+    if args.settle and args.settle > 0:
+        # hold the health/metrics planes open through the recovery
+        # window before the final-line record is printed
+        import time
+
+        time.sleep(args.settle)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(record, f)
@@ -1422,6 +1502,25 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_disable_jit", True)
+    # health plane (r20): parse the spec and build the (not-yet-
+    # subscribed) engine BEFORE any server bind — a malformed spec must
+    # abort without leaking a listener or a subscription
+    engine = None
+    if getattr(args, "health", None) is not None:
+        from randomprojection_tpu.utils import health
+
+        try:
+            spec = health.parse_slo_spec(args.health)
+            engine = health.HealthEngine(slo=spec)
+        except ValueError as e:
+            raise SystemExit(f"--health: {e}")
+    recorder = None
+    if getattr(args, "flight_dump", None):
+        from randomprojection_tpu.utils import telemetry
+
+        recorder = telemetry.FlightRecorder()
+        if engine is not None:
+            engine.recorder = recorder  # watchdog trip ⇒ dump
     live = None
     if getattr(args, "metrics_port", None) is not None:
         # live observability plane (r17): a LiveAggregator subscribed to
@@ -1440,7 +1539,7 @@ def main(argv=None):
         # leak a registered subscription no finally could clean up —
         # keeping telemetry active process-wide for in-process callers
         server = metrics_server.MetricsServer(
-            port=args.metrics_port, aggregator=agg
+            port=args.metrics_port, aggregator=agg, health=engine
         )
         try:
             sub = telemetry.subscribe(agg, maxsize=4096,
@@ -1452,6 +1551,33 @@ def main(argv=None):
         global _METRICS_SERVER
         _METRICS_SERVER = server
         print(f"metrics: serving {server.url}", file=sys.stderr)
+    rec_sub = None
+    if recorder is not None or engine is not None:
+        # subscriptions AFTER the bind (same leak argument as above);
+        # the recorder installs its signal/excepthook handlers last so
+        # a failed subscribe never leaves a handler pointing at a
+        # recorder with no event feed
+        from randomprojection_tpu.utils import telemetry
+
+        try:
+            if recorder is not None:
+                rec_sub = telemetry.subscribe(
+                    recorder, maxsize=4096, name="flight-recorder"
+                )
+                recorder.install(args.flight_dump)
+            if engine is not None:
+                engine.start()
+                if recorder is not None:
+                    recorder.attach_health(engine.active)
+        except BaseException:
+            if rec_sub is not None:
+                recorder.uninstall()
+                telemetry.unsubscribe(rec_sub)
+            if live is not None:
+                _METRICS_SERVER = None
+                live[0].close()
+                telemetry.unsubscribe(live[1])
+            raise
     try:
         rv = {
             "jl-dim": cmd_jl_dim,
@@ -1471,6 +1597,22 @@ def main(argv=None):
         # consume the flag first
         _write_openmetrics(args)
     finally:
+        if engine is not None:
+            engine.close()
+        if recorder is not None:
+            from randomprojection_tpu.utils import telemetry
+
+            # an exception unwinding through here dies AFTER this
+            # finally restores sys.excepthook — dump now, while the
+            # ring is still subscribed, or the crash leaves nothing
+            exc = sys.exc_info()[0]
+            if exc is not None and not issubclass(
+                exc, (SystemExit, KeyboardInterrupt)
+            ):
+                recorder.dump(reason=f"unhandled_exception:{exc.__name__}")
+            recorder.uninstall()
+            if rec_sub is not None:
+                telemetry.unsubscribe(rec_sub)
         if live is not None:
             from randomprojection_tpu.utils import telemetry
 

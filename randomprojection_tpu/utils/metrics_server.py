@@ -54,13 +54,21 @@ class MetricsServer:
     ``MetricsRegistry.snapshot()``-shaped dicts, evaluated at scrape
     time — a source that raises is skipped for that scrape (the
     endpoint must keep answering while a stream is tearing down).
+
+    With a ``health`` engine attached (anything exposing ``ok()`` and
+    ``active()`` — ``health.HealthEngine``), the server also answers
+    ``GET /health`` (r20): 200 while ``ok()``, 503 while any critical
+    detector fires, JSON body listing the active verdicts either way —
+    the ops-probe surface a load balancer or systemd watchdog polls.
     """
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1", *,
-                 aggregator=None, sources=None, start: bool = True):
+                 aggregator=None, sources=None, health=None,
+                 start: bool = True):
         self.host = host
         self._requested_port = int(port)
         self.aggregator = aggregator
+        self.health = health
         self._lock = threading.Lock()
         self._sources: List[Callable[[], dict]] = list(sources or ())
         self._httpd = None
@@ -108,6 +116,22 @@ class MetricsServer:
                 )
         return telemetry.to_openmetrics(*snaps)
 
+    def health_response(self) -> Tuple[int, str]:
+        """``(status, json_body)`` for ``GET /health``: 503 while any
+        critical detector fires, 200 otherwise.  With no engine
+        attached the endpoint stays honest — 200, ``attached: false``
+        (the probe learns the plane is up but ungraded)."""
+        eng = self.health
+        if eng is None:
+            return 200, json.dumps(
+                {"ok": True, "attached": False, "active": []}
+            )
+        ok = bool(eng.ok())
+        return (200 if ok else 503), json.dumps(
+            {"ok": ok, "attached": True, "active": eng.active()},
+            sort_keys=True, default=str,
+        )
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "MetricsServer":
@@ -119,6 +143,27 @@ class MetricsServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
+                if self.path == "/health":
+                    try:
+                        status, body_text = server.health_response()
+                    except Exception:
+                        # an engine mid-teardown must not kill the
+                        # probe; 500 = plane up, grading broken
+                        telemetry.registry().counter_inc(
+                            "metrics.server.render_errors"
+                        )
+                        self.send_response(500)
+                        self.end_headers()
+                        return
+                    body = body_text.encode("utf-8")
+                    self.send_response(status)
+                    self.send_header(
+                        "Content-Type", "application/json; charset=utf-8"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path not in ("/metrics", "/"):
                     self.send_response(404)
                     self.end_headers()
@@ -249,8 +294,17 @@ def _rate_lines(plain: Dict[str, float], prev: Optional[Dict[str, float]],
         "rp_telemetry_subscriber_dropped_total",
         "rp_telemetry_subscriber_errors_total",
     )
+    # per-subscriber drop counters (r20 satellite): the aggregate above
+    # cannot say WHICH observer is chronically overrun, so surface every
+    # rp_telemetry_subscriber_<name>_dropped_total as its own rate line
+    per_sub = tuple(sorted(
+        name for name in plain
+        if name.startswith("rp_telemetry_subscriber_")
+        and name.endswith("_dropped_total")
+        and name != "rp_telemetry_subscriber_dropped_total"
+    ))
     out = []
-    for name in watch:
+    for name in watch + per_sub:
         cur = plain.get(name)
         if cur is None:
             continue
@@ -262,6 +316,20 @@ def _rate_lines(plain: Dict[str, float], prev: Optional[Dict[str, float]],
                 f"  {name:<44} {cur:.0f} total  "
                 f"(+{delta / interval_s:.2f}/s)"
             )
+    return out
+
+
+def _health_lines(plain: Dict[str, float]) -> List[str]:
+    """Active health-verdict gauges (``rp_health_*_firing``, mirrored
+    by ``health.HealthEngine`` each tick) for the live view."""
+    out = []
+    for name in sorted(plain):
+        if not (name.startswith("rp_health_") and name.endswith("_firing")):
+            continue
+        n = plain[name]
+        detector = name[len("rp_health_"):-len("_firing")]
+        state = f"FIRING x{n:.0f}" if n else "ok"
+        out.append(f"  {detector:<24} {state}")
     return out
 
 
@@ -321,6 +389,10 @@ def render_live(plain: Dict[str, float], labeled: Dict[str, dict],
                     for q in sorted(by_q, key=float)
                 )
             )
+    health = _health_lines(plain)
+    if health:
+        lines.append("health verdicts:")
+        lines.extend(health)
     rates = _rate_lines(plain, prev, interval_s)
     if rates:
         lines.append("degraded counters:")

@@ -131,6 +131,7 @@ __all__ = [
     "subscribe",
     "unsubscribe",
     "LiveAggregator",
+    "FlightRecorder",
 ]
 
 SCHEMA_VERSION = 2
@@ -242,6 +243,16 @@ class EVENTS:
     INDEX_LSH_DEVICE_DISPATCH = "index.lsh.device_dispatch"
     INDEX_LSH_DEVICE_UPLOAD = "index.lsh.device_upload"
     INDEX_LSH_ADAPTIVE = "index.lsh.adaptive"
+    # health plane (ISSUE 18 / r20): typed detector verdicts with a
+    # firing/cleared lifecycle (utils/health.py emits, deduplicated and
+    # rate-limited), plus the flight recorder's dump record.
+    # Deliberately NOT a family — rogue ``health.*`` names stay
+    # lintable (rp02_health_bad.py).
+    HEALTH_SLO_BURN = "health.slo_burn"
+    HEALTH_STALL = "health.stall"
+    HEALTH_QUEUE_PINNED = "health.queue_pinned"
+    HEALTH_DEGRADED_SPIKE = "health.degraded_spike"
+    HEALTH_FLIGHT_DUMP = "health.flight_dump"
 
     # runtime-completed name families.  ``*_FAMILY`` constants are the
     # prefixes callers build on (today: the per-kernel-path hash counter
@@ -295,7 +306,11 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # REENTRANT: the flight recorder's fatal-signal dump snapshots
+        # this registry FROM the main thread, which may have been
+        # interrupted while holding this very lock inside counter_inc —
+        # a plain Lock would self-deadlock the signal handler (r20)
+        self._lock = threading.RLock()
         self._counters: dict = {}
         self._gauges: dict = {}
         self._hists: dict = {}
@@ -731,6 +746,12 @@ class Subscription:
             with self._lock:
                 self._dropped += 1
             _DEFAULT_REGISTRY.counter_inc("telemetry.subscriber.dropped")
+            # per-subscriber tally (ISSUE 18 satellite): one aggregate
+            # counter cannot say WHICH observer is chronically overrun —
+            # doctor --live renders a drop rate per subscriber from these
+            _DEFAULT_REGISTRY.counter_inc(
+                f"telemetry.subscriber.{self.name}.dropped"
+            )
 
     # dispatch side — this subscription's own daemon thread
     def _run(self) -> None:
@@ -995,6 +1016,222 @@ class LiveAggregator:
             if q.get("capacity") is not None:
                 g("live.queue.capacity", q["capacity"])
         return {"counters": {}, "gauges": gauges, "histograms": {}}
+
+
+class FlightRecorder:
+    """Always-on crash evidence (ISSUE 18): a fixed-size in-memory ring
+    of the last ``capacity`` events/spans — the cheapest possible
+    subscriber (one deque append per event, no JSONL sink required) —
+    dumped atomically to a self-describing postmortem file when the
+    process dies.
+
+    Usage: ``rec = FlightRecorder(); sub = subscribe(rec, ...)`` (the
+    instance is itself the subscriber callable), then
+    ``rec.install(path)`` to arm the fatal-signal (SIGTERM/SIGABRT)
+    handlers and the unhandled-exception hook.  ``dump()`` can also be
+    called on demand (the health watchdog trips it; see
+    ``utils/health.py``).  ``cli doctor --postmortem <dump>``
+    reconstructs the final seconds from the result.
+
+    Signal-safety argument (docs/ARCHITECTURE.md "Health plane"): CPython
+    runs signal handlers in the MAIN thread at bytecode boundaries — not
+    in async-signal context — so file IO inside the handler is safe.
+    Locks are the real hazard: the interrupted main-thread frame may
+    HOLD any lock the hot path takes (the JSONL sink lock inside
+    ``emit``, the subscriber-list lock, the registry lock inside
+    ``counter_inc``), and a handler that blocks on one of those
+    self-deadlocks — same thread, never released.  Three measures close
+    every such path: (1) the signal-context dump never re-enters the
+    event spine (``emit_event=False`` — no sink lock, no subscriber
+    lock); (2) the two locks the dump DOES take (ring, registry) are
+    reentrant, so an interrupted holder on the main thread is re-entry,
+    not deadlock; (3) a signal arriving during a dump cannot re-enter
+    the dump itself (the non-blocking ``_dump_guard`` makes the nested
+    dump a no-op).  After dumping, the previous signal disposition is
+    restored and the signal re-raised, so the process still dies with
+    the correct exit status (``kill -TERM`` still exits 143).
+
+    Dump format (one JSON object, written tmp→fsync→``os.replace`` — the
+    r11 durable-write discipline, so a crash mid-dump leaves the
+    previous dump or nothing, never a torn file)::
+
+        {"format": "rp-flight-recorder", "v": 1, "pid": ..., "ts": ...,
+         "reason": "signal:SIGTERM" | "unhandled_exception:..." |
+                   "watchdog:<detector>" | "on_demand",
+         "capacity": N, "events": [<the ring, oldest first>],
+         "counters": <registry().snapshot()>,
+         "health": <active verdicts, when a health engine is attached>}
+    """
+
+    FORMAT = "rp-flight-recorder"
+    VERSION = 1
+
+    def __init__(self, capacity: int = 2048):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        # reentrant for the same reason as the registry lock: the
+        # signal handler's dump copies the ring on the main thread,
+        # which may have been interrupted inside install/attach_health
+        self._lock = threading.RLock()
+        # non-blocking reentrancy guard: a signal landing mid-dump must
+        # skip the nested dump, not deadlock on it
+        self._dump_guard = threading.Lock()
+        self._path: Optional[str] = None
+        self._health = None  # zero-arg callable -> active verdict list
+        self._prev_handlers: dict = {}
+        self._prev_excepthook = None
+        self._installed_signals: tuple = ()
+
+    # the subscriber callable face — one bounded append, never blocks
+    def __call__(self, rec: dict) -> None:
+        with self._lock:
+            self._ring.append(rec)
+
+    def attach_health(self, fn) -> None:
+        """Attach a zero-arg callable returning the active health
+        verdicts (``HealthEngine.active``); its result rides in every
+        dump so the postmortem names the detectors firing at death."""
+        with self._lock:
+            self._health = fn
+
+    def snapshot(self) -> list:
+        """The ring's current contents, oldest first (thread-safe)."""
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "on_demand", *,
+             emit_event: bool = True) -> Optional[str]:
+        """Write the postmortem file atomically and return its path.
+        Returns None when no path is known or a dump is already in
+        progress (a signal arriving mid-dump).  Never raises during
+        interpreter teardown — the dump is best-effort evidence, not a
+        new crash.
+
+        ``emit_event=False`` is the SIGNAL-CONTEXT mode: the handler
+        may have interrupted the main thread while it held the JSONL
+        sink lock or the subscriber-list lock inside ``emit()``, so the
+        dump must never re-enter the event spine from there — the file
+        itself is the evidence."""
+        with self._lock:
+            path = path or self._path
+        if path is None:
+            return None
+        if not self._dump_guard.acquire(blocking=False):
+            return None  # nested dump (signal during dump): skip
+        try:
+            with self._lock:
+                events = list(self._ring)
+                health_fn = self._health
+            health = None
+            if health_fn is not None:
+                try:
+                    health = health_fn()
+                except Exception:
+                    # the postmortem must still land when the engine is
+                    # mid-teardown; record that the section is missing
+                    _DEFAULT_REGISTRY.counter_inc(
+                        "telemetry.flight.health_snapshot_errors"
+                    )
+            rec = {
+                "format": self.FORMAT,
+                "v": self.VERSION,
+                "pid": os.getpid(),
+                "ts": time.time(),
+                "reason": reason,
+                "capacity": self.capacity,
+                "events": events,
+                "counters": _DEFAULT_REGISTRY.snapshot(),
+                "health": health,
+            }
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(rec, f, separators=(",", ":"))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            _DEFAULT_REGISTRY.counter_inc("telemetry.flight.dumps")
+            if emit_event:
+                emit(
+                    EVENTS.HEALTH_FLIGHT_DUMP, reason=reason, path=path,
+                    events=len(events),
+                )
+            return path
+        except Exception:
+            if _finalizing():
+                return None
+            raise
+        finally:
+            self._dump_guard.release()
+
+    # -- fatal-path arming ---------------------------------------------------
+
+    def install(self, path: str, *, signals: Optional[tuple] = None,
+                on_exception: bool = True) -> None:
+        """Arm the recorder: dump to ``path`` on SIGTERM/SIGABRT (or the
+        given ``signals``) and — with ``on_exception`` — on any unhandled
+        exception.  Must run on the MAIN thread (CPython delivers
+        signals there; ``signal.signal`` enforces it).  The previous
+        dispositions are saved and re-raised after the dump, so exit
+        codes are preserved.  ``uninstall()`` restores everything."""
+        import signal as _signal
+
+        if signals is None:
+            signals = (_signal.SIGTERM, _signal.SIGABRT)
+        with self._lock:
+            self._path = path
+
+        def _on_signal(signum, frame):
+            try:
+                name = _signal.Signals(signum).name
+            except ValueError:  # pragma: no cover — unnamed signal
+                name = str(signum)
+            # emit_event=False: the spine's locks may be held by the
+            # very frame this handler interrupted (see dump docstring)
+            self.dump(reason=f"signal:{name}", emit_event=False)
+            # restore the pre-install disposition and re-raise so the
+            # process still dies with the right exit status (TERM→143).
+            # A None previous handler means it was installed at the C
+            # level (e.g. faulthandler) — SIG_DFL is the only honest
+            # restore signal.signal accepts for it
+            prev = self._prev_handlers.get(signum)
+            _signal.signal(
+                signum, prev if prev is not None else _signal.SIG_DFL
+            )
+            os.kill(os.getpid(), signum)
+
+        for signum in signals:
+            self._prev_handlers[signum] = _signal.signal(
+                signum, _on_signal
+            )
+        self._installed_signals = tuple(signals)
+        if on_exception:
+            prev_hook = sys.excepthook
+            self._prev_excepthook = prev_hook
+
+            def _on_exception(exc_type, exc, tb):
+                self.dump(
+                    reason=f"unhandled_exception:{exc_type.__name__}"
+                )
+                prev_hook(exc_type, exc, tb)
+
+            sys.excepthook = _on_exception
+
+    def uninstall(self) -> None:
+        """Restore the previous signal dispositions and excepthook.
+        Idempotent."""
+        import signal as _signal
+
+        for signum in self._installed_signals:
+            prev = self._prev_handlers.pop(signum, None)
+            if prev is not None:
+                _signal.signal(signum, prev)
+        self._installed_signals = ()
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
 
 
 # -- tracing spans (schema v2) ------------------------------------------------

@@ -46,7 +46,25 @@ from randomprojection_tpu.utils.telemetry import (
     registered_event,
 )
 
-__all__ = ["build_report", "render_report", "DEGRADED_EVENTS"]
+__all__ = [
+    "build_report",
+    "render_report",
+    "build_postmortem",
+    "render_postmortem",
+    "DEGRADED_EVENTS",
+    "HEALTH_VERDICT_EVENTS",
+]
+
+# health-plane verdict events (r20): each carries a firing/cleared
+# ``status`` lifecycle; the doctor folds them into a per-detector
+# transition count plus the set of keys still firing at end-of-log —
+# the post-hoc twin of ``doctor --live``'s verdict view
+HEALTH_VERDICT_EVENTS = (
+    EVENTS.HEALTH_SLO_BURN,
+    EVENTS.HEALTH_STALL,
+    EVENTS.HEALTH_QUEUE_PINNED,
+    EVENTS.HEALTH_DEGRADED_SPIKE,
+)
 
 # event names that mark a degraded execution path; the audit reports a
 # count for each even when zero, so "nothing degraded" is an explicit
@@ -171,6 +189,14 @@ def build_report(path: str) -> dict:
     # extracted at the end by the shared bucket math
     lat_hists: dict = {}
     loadgen_runs: list = []
+    # health plane (r20): per-detector firing/cleared transition counts,
+    # the per-key last-seen status (what was STILL firing when the log
+    # ended), flight-recorder dumps, and the per-subscriber drop tally
+    # the live-plane overflow events carry
+    health_counts: dict = {}       # event -> {"firing": n, "cleared": n}
+    health_last: dict = {}         # (event, key) -> last status
+    flight_dumps: list = []
+    subscriber_drops: dict = {}    # subscriber name -> dropped total
     # LSH candidate tier (ISSUE 15): per-tile candidate generation,
     # fallback reasons, bucket-build folds
     lsh_tiles = 0
@@ -367,6 +393,25 @@ def build_report(path: str) -> dict:
         elif name == EVENTS.INDEX_LSH_BUILD:
             lsh_builds += 1
             lsh_build_rows += e.get("rows", 0) or 0
+        elif name in HEALTH_VERDICT_EVENTS:
+            status = str(e.get("status") or "firing")
+            d = health_counts.setdefault(name, {"firing": 0, "cleared": 0})
+            d[status] = d.get(status, 0) + 1
+            health_last[(name, str(e.get("key")))] = status
+        elif name == EVENTS.HEALTH_FLIGHT_DUMP:
+            flight_dumps.append({
+                "reason": e.get("reason"),
+                "path": e.get("path"),
+                "events": e.get("events"),
+            })
+        elif name == EVENTS.TELEMETRY_SUBSCRIBER_DROPPED:
+            # the rate-limited overflow report names its subscriber and
+            # carries the running total — keep the max (totals are
+            # monotonic per subscriber) so the audit says WHO overran
+            sub = str(e.get("subscriber") or "?")
+            total = e.get("dropped_total", e.get("dropped", 0)) or 0
+            subscriber_drops[sub] = max(subscriber_drops.get(sub, 0),
+                                        int(total))
         elif name == EVENTS.LOADGEN_RUN:
             loadgen_runs.append({
                 "requests": e.get("requests"),
@@ -550,6 +595,26 @@ def build_report(path: str) -> dict:
             else None
         ),
         "loadgen": loadgen_runs or None,
+        "health": (
+            {
+                "verdicts": {
+                    name: dict(c)
+                    for name, c in sorted(health_counts.items())
+                },
+                "still_firing": sorted(
+                    f"{ev} {key}"
+                    for (ev, key), st in health_last.items()
+                    if st == "firing"
+                ),
+                "flight_dumps": flight_dumps,
+            }
+            if (health_counts or flight_dumps)
+            else None
+        ),
+        "subscriber_drops": (
+            dict(sorted(subscriber_drops.items()))
+            if subscriber_drops else None
+        ),
         "degraded": degraded,
         "unregistered_events": unregistered,
         "recovery": (
@@ -727,11 +792,36 @@ def render_report(report: dict) -> str:
                 f"{r['errors']}, max submit lag {r['max_lag_s']}s, "
                 f"schedule {str(r['schedule_sha256'])[:12]}"
             )
+    hp = report.get("health")
+    if hp:
+        lines.append("")
+        lines.append("health verdicts (r20 detectors):")
+        for name, c in hp["verdicts"].items():
+            lines.append(
+                f"  {name:<28} fired {c.get('firing', 0)}x, "
+                f"cleared {c.get('cleared', 0)}x"
+            )
+        if hp["still_firing"]:
+            lines.append(
+                "  STILL FIRING at end of log: "
+                + ", ".join(hp["still_firing"])
+            )
+        for d in hp["flight_dumps"]:
+            lines.append(
+                f"  flight dump: {d['path']} ({d['reason']}, "
+                f"{d['events']} ring events)"
+            )
     lines.append("")
     lines.append("degraded-event audit:")
     worst = [(k, v) for k, v in report["degraded"].items() if v]
     for k, v in report["degraded"].items():
         lines.append(f"  {k:<36} {v}")
+    subs = report.get("subscriber_drops")
+    if subs:
+        # WHICH observer overran its bounded queue, not just how often
+        # the overflow report fired (r20 satellite)
+        for sub, n in subs.items():
+            lines.append(f"    subscriber[{sub}] dropped {n}")
     lines.append(
         "  -> " + (
             "DEGRADED paths taken: " + ", ".join(k for k, _ in worst)
@@ -797,4 +887,187 @@ def render_report(report: dict) -> str:
                     f"regression tripwire ({tw['baseline']}): no verdict "
                     "recorded in that round's record"
                 )
+    return "\n".join(lines) + "\n"
+
+
+# -- flight-recorder postmortem (r20) ----------------------------------------
+
+
+def build_postmortem(dump: dict) -> dict:
+    """Reconstruct the final seconds from a ``FlightRecorder`` dump
+    (the JSON ``telemetry.FlightRecorder.dump`` writes): last-known
+    per-stage activity, spans in flight at death, the detectors firing
+    at death, and a counter digest.  Raises ``ValueError`` on a file
+    that is not a flight-recorder dump — the doctor must never render
+    a confident postmortem from the wrong artifact."""
+    if dump.get("format") != "rp-flight-recorder":
+        raise ValueError(
+            "not a flight-recorder dump (format="
+            f"{dump.get('format')!r}, want 'rp-flight-recorder')"
+        )
+    death_ts = dump.get("ts")
+    events = dump.get("events") or []
+    stages: dict = {}        # stage -> {"last_ts", "events"}
+    open_spans: dict = {}    # span_id -> span_start record
+    window_t0 = None
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        ts = e.get("ts")
+        if isinstance(ts, (int, float)):
+            window_t0 = ts if window_t0 is None else min(window_t0, ts)
+        name = e.get("event")
+        if name in (EVENTS.SPAN_START, EVENTS.SPAN_END):
+            stage = str(e.get("name"))
+            st = stages.setdefault(stage, {"last_ts": None, "events": 0})
+            st["events"] += 1
+            if isinstance(ts, (int, float)):
+                st["last_ts"] = ts if st["last_ts"] is None else max(
+                    st["last_ts"], ts
+                )
+            sid = e.get("span_id")
+            if sid is not None:
+                if name == EVENTS.SPAN_START:
+                    open_spans[sid] = e
+                else:
+                    open_spans.pop(sid, None)
+
+    def _age(ts):
+        if ts is None or not isinstance(death_ts, (int, float)):
+            return None
+        return round(death_ts - ts, 3)
+
+    stage_rows = [
+        {
+            "stage": stage,
+            "events": st["events"],
+            "last_ts": st["last_ts"],
+            "age_s": _age(st["last_ts"]),
+        }
+        for stage, st in sorted(
+            stages.items(),
+            key=lambda kv: kv[1]["last_ts"] or 0.0,
+            reverse=True,
+        )
+    ]
+    # "the stage active at death": most-recently-heartbeating stage,
+    # preferring one with a span still OPEN in the ring window
+    last_active = None
+    open_stages = {str(s.get("name")) for s in open_spans.values()}
+    for row in stage_rows:
+        if row["stage"] in open_stages:
+            last_active = row["stage"]
+            break
+    if last_active is None and stage_rows:
+        last_active = stage_rows[0]["stage"]
+    in_flight = [
+        {
+            "name": str(s.get("name")),
+            "trace_id": s.get("trace_id"),
+            "span_id": s.get("span_id"),
+            "age_s": _age(s.get("ts")),
+        }
+        for s in sorted(
+            open_spans.values(), key=lambda s: s.get("ts") or 0.0
+        )
+    ]
+    tail = [
+        {"event": e.get("event"), "age_s": _age(e.get("ts"))}
+        for e in events[-10:]
+        if isinstance(e, dict)
+    ]
+    counters = {}
+    snap = dump.get("counters") or {}
+    for k, v in sorted((snap.get("counters") or {}).items()):
+        if v:
+            counters[k] = v
+    health = dump.get("health")
+    return {
+        "format": dump.get("format"),
+        "v": dump.get("v"),
+        "pid": dump.get("pid"),
+        "reason": dump.get("reason"),
+        "death_ts": death_ts,
+        "ring": {
+            "events": len(events),
+            "capacity": dump.get("capacity"),
+            "window_s": (
+                round(death_ts - window_t0, 3)
+                if (window_t0 is not None
+                    and isinstance(death_ts, (int, float)))
+                else None
+            ),
+        },
+        "last_active_stage": last_active,
+        "stages": stage_rows,
+        "in_flight": in_flight,
+        "firing": health if isinstance(health, list) else [],
+        "health_error": (
+            health.get("error") if isinstance(health, dict) else None
+        ),
+        "tail": tail,
+        "counters": counters,
+    }
+
+
+def render_postmortem(pm: dict) -> str:
+    """Human-readable postmortem (``cli doctor --postmortem``)."""
+    lines = [
+        f"flight-recorder postmortem: pid {pm['pid']}, "
+        f"reason {pm['reason']!r}",
+        f"  ring: {pm['ring']['events']} events"
+        + (
+            f" over the final {pm['ring']['window_s']}s"
+            if pm["ring"]["window_s"] is not None else ""
+        )
+        + f" (capacity {pm['ring']['capacity']})",
+    ]
+    if pm["last_active_stage"]:
+        lines.append(f"  last active stage: {pm['last_active_stage']}")
+    if pm["stages"]:
+        lines.append("")
+        lines.append("last-known per-stage activity (age at death):")
+        for row in pm["stages"]:
+            age = row["age_s"]
+            lines.append(
+                f"  {row['stage']:<18} x{row['events']:<6}"
+                + (f" last {age:.3f}s before death" if age is not None
+                   else " (no timestamp)")
+            )
+    if pm["in_flight"]:
+        lines.append("")
+        lines.append("spans in flight at death:")
+        for s in pm["in_flight"]:
+            lines.append(
+                f"  {s['name']:<18} trace {str(s['trace_id'])[:12]}"
+                + (f"  open {s['age_s']:.3f}s" if s["age_s"] is not None
+                   else "")
+            )
+    if pm["firing"]:
+        lines.append("")
+        lines.append("detectors firing at death:")
+        for v in pm["firing"]:
+            lines.append(
+                f"  {v.get('detector', '?'):<28} key={v.get('key')}"
+                + ("  [critical]" if v.get("critical") else "")
+            )
+    elif pm.get("health_error"):
+        lines.append("")
+        lines.append(
+            f"  (health snapshot failed at dump: {pm['health_error']})"
+        )
+    if pm["tail"]:
+        lines.append("")
+        lines.append("final events:")
+        for e in pm["tail"]:
+            lines.append(
+                f"  {str(e['event']):<34}"
+                + (f" {e['age_s']:.3f}s before death"
+                   if e["age_s"] is not None else "")
+            )
+    if pm["counters"]:
+        lines.append("")
+        lines.append("nonzero counters at death:")
+        for k, v in pm["counters"].items():
+            lines.append(f"  {k:<44} {v:g}")
     return "\n".join(lines) + "\n"

@@ -1,0 +1,23 @@
+"""RP02 fixture (ISSUE r20 satellite): health-plane emitters using
+``health.*`` event names that are NOT in ``telemetry.EVENTS``.  Linted
+against the REAL registry — the health namespace deliberately has NO
+family prefix, so every verdict/dump event must be individually
+registered (a family would wave rogue detector names past the doctor's
+health-verdict section and the flight-recorder audit)."""
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+
+def rogue_detector(burn):
+    # VIOLATION: a verdict event dodging the registry — invisible to
+    # the doctor's health section and the /metrics firing gauges
+    telemetry.emit("health.rogue_burn", status="firing", burn=burn)
+    # ok: the registered burn-rate verdict
+    telemetry.emit(EVENTS.HEALTH_SLO_BURN, status="firing", burn=burn)
+
+
+def rogue_dump(path):
+    # VIOLATION: a flight-dump event outside the registry
+    telemetry.emit("health.rogue_dump", path=path)
+    # ok: the registered flight-recorder dump record
+    telemetry.emit(EVENTS.HEALTH_FLIGHT_DUMP, path=path)

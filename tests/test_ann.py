@@ -145,8 +145,7 @@ def test_buckets_candidates_are_union_of_probed_runs():
 
 
 # -- full-probe parity + ladder ----------------------------------------------
-
-
+@pytest.mark.slow
 def test_full_probe_parity_multichunk_ragged_tombstones():
     codes = _rand_codes(360, 3, seed=3)
     q = _rand_codes(6, 3, seed=4)
@@ -162,8 +161,7 @@ def test_full_probe_parity_multichunk_ragged_tombstones():
     D[:, idx._dead] = 20 + 1
     rd, ri = sk._host_topk_select(D, M)
     assert np.array_equal(d, rd) and np.array_equal(i, ri)
-
-
+@pytest.mark.slow
 def test_fallback_ladder_density_and_starvation():
     codes = _corpus(seed=5)
     q = _queries(seed=6)
@@ -186,8 +184,7 @@ def test_fallback_ladder_density_and_starvation():
     d, i = starved.query_topk(q, M, probes=1)
     assert np.array_equal(d, rd) and np.array_equal(i, ri)
     assert reg.counter("index.lsh.fallbacks") > f0
-
-
+@pytest.mark.slow
 def test_probes_zero_and_constructor_default():
     codes = _corpus(seed=7)
     q = _queries(seed=8)
@@ -270,6 +267,7 @@ def test_rerank_host_rung_parity(monkeypatch):
 # -- sharded tier ------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_sharded_full_probe_parity_tombstones_id_offset():
     codes = _corpus(seed=11)
     q = _queries(seed=12)
@@ -290,6 +288,7 @@ def test_sharded_full_probe_parity_tombstones_id_offset():
     assert (np.take_along_axis(D, ip - off, axis=1) == dp).all()
 
 
+@pytest.mark.slow
 def test_sharded_per_shard_fallback_mix():
     """Shards decide the ladder independently: a dense shard serves
     exact while the others stay on the candidate path — the merge is
@@ -304,8 +303,7 @@ def test_sharded_per_shard_fallback_mix():
 
 
 # -- serving integration -----------------------------------------------------
-
-
+@pytest.mark.slow
 def test_topkserver_serves_lsh_index():
     codes = _corpus(seed=15)
     q = _queries(seed=16)
@@ -329,6 +327,7 @@ def test_topkserver_serves_lsh_index():
     assert (coalesced[0] <= direct[0]).all()
 
 
+@pytest.mark.slow
 def test_sharded_topkserver_serves_lsh_replicas():
     from randomprojection_tpu.serving import ShardedTopKServer
 
@@ -346,8 +345,7 @@ def test_sharded_topkserver_serves_lsh_replicas():
 
 
 # -- durability --------------------------------------------------------------
-
-
+@pytest.mark.slow
 def test_durable_roundtrip_bit_identical_keys(tmp_path):
     from randomprojection_tpu import durable
 
@@ -439,8 +437,7 @@ def test_durable_layout_fungible_and_pre_lsh(tmp_path):
     assert resharded.band_plan == fresh.band_plan
     assert np.array_equal(resharded._lsh_global_keys(),
                           fresh._buckets.keys)
-
-
+@pytest.mark.slow
 def test_compact_remaps_buckets_consistently():
     codes = _corpus(seed=24)
     idx = LSHSimHashIndex(codes[:300], **BANDS, fallback_density=1.0)
@@ -462,6 +459,7 @@ def test_compact_remaps_buckets_consistently():
     assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
 
 
+@pytest.mark.slow
 def test_sharded_compact_rebuilds_per_shard_buckets():
     codes = _corpus(seed=26)
     sh = LSHShardedSimHashIndex(codes, n_shards=3, **BANDS,
@@ -526,6 +524,7 @@ def test_lsh_events_and_doctor_section(tmp_path):
 # -- bench record + tripwire (the ISSUE 15 acceptance gates) -----------------
 
 
+@pytest.mark.slow
 def test_bench_lsh_curve_meets_acceptance_gates():
     """The committed bench fixture must show a probe setting with
     recall@10 >= 0.95 while re-ranking < 10% of the corpus — asserted
@@ -602,6 +601,7 @@ def test_bench_lsh_rates_compact_and_recall_tripwire():
     assert rates2["config4.topk.lsh_queries_per_s"] == (900.0, False)
 
 
+@pytest.mark.slow
 def test_cli_topk_bench_forwards_probes(capsys, monkeypatch):
     """`cli topk-bench --probes` measures the LSH curve alongside the
     serving modes and records recall + q/s per probe count."""

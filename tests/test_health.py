@@ -1,0 +1,632 @@
+"""Health plane (ISSUE 18 / r20): the --health spec grammar, the four
+detectors' fire/clear hysteresis and window math under an explicit
+clock (no threads, no sleeps), the HealthEngine's verdict lifecycle +
+gauge mirroring + watchdog-tripped flight dump, the FlightRecorder ring
+/ atomic dump / install-uninstall, the doctor's postmortem
+reconstruction, GET /health over real HTTP (503 while a critical
+detector fires, 200 after it clears), the drop-never-block pin with the
+engine subscribed, and a subprocess SIGTERM kill leg."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from randomprojection_tpu.utils import health, metrics_server, telemetry
+from randomprojection_tpu.utils.health import (
+    BurnRateDetector,
+    DegradedSpikeDetector,
+    HealthEngine,
+    QueuePinnedDetector,
+    StallWatchdog,
+    parse_slo_spec,
+)
+from randomprojection_tpu.utils.telemetry import EVENTS, FlightRecorder
+from randomprojection_tpu.utils.trace_report import (
+    build_postmortem,
+    render_postmortem,
+)
+
+
+def _latency(total_s, label=None, server="topk", ts=None):
+    rec = {"event": EVENTS.SERVE_LATENCY_REQUEST, "total_s": total_s,
+           "server": server}
+    if label is not None:
+        rec["label"] = label
+    if ts is not None:
+        rec["ts"] = ts
+    return rec
+
+
+# -- parse_slo_spec ----------------------------------------------------------
+
+
+def test_parse_slo_spec_grammar():
+    assert parse_slo_spec(None) == {
+        "default_ms": None, "labels": {}, "config": {}
+    }
+    assert parse_slo_spec("") == {
+        "default_ms": None, "labels": {}, "config": {}
+    }
+    spec = parse_slo_spec("25, tenant-a=10, budget=0.05, stall=2.5")
+    assert spec["default_ms"] == 25.0
+    assert spec["labels"] == {"tenant-a": 10.0}
+    assert spec["config"] == {"budget": 0.05, "stall": 2.5}
+    # every reserved key routes to config, never to labels
+    spec = parse_slo_spec(
+        "budget=0.01,fast=1,slow=5,fire=8,clear=4,stall=3,tick=0.1"
+    )
+    assert not spec["labels"]
+    assert set(spec["config"]) == set(health._SPEC_KEYS)
+
+
+@pytest.mark.parametrize("bad", [
+    "not-a-number",           # bare entry that isn't a float
+    "tenant-a=fast",          # label value that isn't a float
+    "tenant-a=0",             # non-positive target
+    "budget=-1",              # non-positive config value
+    "-1",                     # non-positive bare default
+    "=5",                     # empty label
+])
+def test_parse_slo_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_slo_spec(bad)
+
+
+# -- BurnRateDetector --------------------------------------------------------
+
+
+def test_burn_rate_windows_fire_independently():
+    """A burst confined to the fast window fires ONLY the fast key: the
+    slow window amortizes the same violations below fire_burn."""
+    det = BurnRateDetector(parse_slo_spec("10,fast=5,slow=60"))
+    t0 = 1000.0
+    # 300 in-SLO requests spread over the 50s before the burst...
+    for i in range(300):
+        det.on_event(_latency(0.001), t0 + i * (50.0 / 300.0))
+    # ...then a 4s burst of hard violations
+    for i in range(20):
+        det.on_event(_latency(0.5), t0 + 50.0 + i * 0.2)
+    now = t0 + 55.0
+    det.evaluate(now)
+    fired = dict(det.firing_keys())
+    assert "topk[*]/fast" in fired
+    assert "topk[*]/slow" not in fired
+    assert fired["topk[*]/fast"]["burn"] == pytest.approx(100.0)
+    assert fired["topk[*]/fast"]["window"] == "fast"
+    # once the burst ages out of the fast window the key clears, with
+    # the held duration stamped on the transition
+    det.drain()
+    det.evaluate(t0 + 62.0)
+    trans = det.drain()
+    assert [t["status"] for t in trans] == ["cleared"]
+    assert trans[0]["key"] == "topk[*]/fast"
+    assert trans[0]["held_s"] >= 0
+
+
+def test_burn_rate_min_count_gates_thin_evidence():
+    """5 violations out of 5 samples is burn 100 — but below min_count
+    it must NOT fire (one slow request at startup is not an incident)."""
+    det = BurnRateDetector(parse_slo_spec("10"), min_count=10)
+    for i in range(5):
+        det.on_event(_latency(0.5), 1000.0 + i * 0.1)
+    det.evaluate(1001.0)
+    assert det.firing_keys() == []
+
+
+def test_burn_rate_hysteresis_band_holds():
+    """Between clear_burn and fire_burn the verdict keeps its previous
+    state: a not-firing key stays off, a firing key stays on."""
+    spec = parse_slo_spec("10,fast=5,slow=60,fire=10,clear=5")
+    det = BurnRateDetector(spec)
+    # 7% violations => burn 7: inside the band, never fired => stays off
+    for i in range(100):
+        det.on_event(_latency(0.5 if i < 7 else 0.001), 1000.0 + i * 0.04)
+    det.evaluate(1004.5)
+    assert det.firing_keys() == []
+    # push to burn 100 => fires
+    for i in range(50):
+        det.on_event(_latency(0.5), 1004.5 + i * 0.01)
+    det.evaluate(1005.1)
+    assert any(k.endswith("/fast") for k, _ in det.firing_keys())
+    det.drain()
+    # decay back into the band (burn 7): the firing key must HOLD
+    det2_now = 1012.0  # violations aged out of fast; seed band-rate mix
+    for i in range(100):
+        det.on_event(
+            _latency(0.5 if i < 7 else 0.001), det2_now + i * 0.04
+        )
+    det.evaluate(det2_now + 4.5)
+    assert any(k.endswith("/fast") for k, _ in det.firing_keys())
+    assert all(t["status"] != "cleared" for t in det.drain()
+               if t["key"].endswith("/fast"))
+
+
+def test_burn_rate_per_label_targets():
+    """A per-label target grades that label's requests; other labels
+    fall back to the default."""
+    det = BurnRateDetector(parse_slo_spec("100,tenant-a=1,fast=5,slow=60"))
+    for i in range(20):
+        # 10ms requests: violate tenant-a's 1ms, honor tenant-b's 100ms
+        det.on_event(_latency(0.010, label="tenant-a"), 1000.0 + i * 0.1)
+        det.on_event(_latency(0.010, label="tenant-b"), 1000.0 + i * 0.1)
+    det.evaluate(1002.5)
+    keys = [k for k, _ in det.firing_keys()]
+    assert any(k.startswith("topk[tenant-a]/") for k in keys)
+    assert not any(k.startswith("topk[tenant-b]/") for k in keys)
+    fields = dict(det.firing_keys())["topk[tenant-a]/fast"]
+    assert fields["target_ms"] == 1.0
+
+
+def test_burn_rate_constructor_validation():
+    with pytest.raises(ValueError):
+        BurnRateDetector(parse_slo_spec("10,budget=2"))  # budget > 1
+    with pytest.raises(ValueError):
+        BurnRateDetector(parse_slo_spec("10,fast=60,slow=60"))
+    with pytest.raises(ValueError):
+        BurnRateDetector(parse_slo_spec("10,fire=5,clear=5"))
+
+
+def test_burn_rate_refire_is_rate_limited():
+    """A still-firing key re-emits at most every refire_s — not once
+    per tick."""
+    det = BurnRateDetector(parse_slo_spec("10,fast=5,slow=60"),
+                           refire_s=10.0)
+    for i in range(20):
+        det.on_event(_latency(0.5), 1000.0 + i * 0.1)
+    det.evaluate(1002.5)
+    assert sum(t["status"] == "firing" for t in det.drain()) >= 1
+    for dt in (0.25, 0.5, 0.75, 1.0):  # four more ticks, well inside
+        det.on_event(_latency(0.5), 1002.5 + dt)
+        det.evaluate(1002.5 + dt)
+    assert det.drain() == []  # dedup: no re-emission inside refire_s
+    det.on_event(_latency(0.5), 1013.5)
+    det.evaluate(1013.5)  # past refire_s: one rate-limited re-emit
+    refires = [t for t in det.drain() if t["status"] == "firing"]
+    assert len(refires) >= 1
+    assert all(t["since"] <= 1002.5 for t in refires)
+
+
+# -- StallWatchdog -----------------------------------------------------------
+
+
+def _feed_stall(det, t0, beats=5, stage="hash", depth=2):
+    for i in range(beats):
+        det.on_event(
+            {"event": EVENTS.SPAN_START, "name": stage}, t0 + i * 0.1
+        )
+    det.on_event(
+        {"event": EVENTS.STREAM_PREFETCH_DELIVER, "queue_depth": depth,
+         "capacity": 2},
+        t0 + beats * 0.1,
+    )
+
+
+def test_stall_fires_after_timeout_with_pinned_queue():
+    det = StallWatchdog(timeout_s=5.0, min_events=3)
+    _feed_stall(det, 1000.0)
+    det.evaluate(1003.0)   # only ~2.5s silent: not yet
+    assert det.firing_keys() == []
+    det.evaluate(1006.0)   # >5s silent, queue sample stale at depth 2
+    fired = dict(det.firing_keys())
+    assert "hash" in fired
+    assert fired["hash"]["silent_s"] >= 5.0
+    assert fired["hash"]["queue_depth"] == 2
+    # a fresh heartbeat clears the stall
+    det.drain()
+    det.on_event({"event": EVENTS.SPAN_END, "name": "hash"}, 1007.0)
+    det.evaluate(1007.5)
+    assert det.firing_keys() == []
+    assert [t["status"] for t in det.drain()] == ["cleared"]
+
+
+def test_stall_drained_queue_is_end_of_run_not_stall():
+    """Silence with the last delivered depth at 0 is a FINISHED run —
+    the queue guard must hold the verdict down."""
+    det = StallWatchdog(timeout_s=5.0, min_events=3)
+    _feed_stall(det, 1000.0, depth=0)
+    det.evaluate(1020.0)
+    assert det.firing_keys() == []
+
+
+def test_stall_min_events_gates_stage_that_never_started():
+    det = StallWatchdog(timeout_s=5.0, min_events=3)
+    det.on_event({"event": EVENTS.SPAN_START, "name": "h2d"}, 1000.0)
+    det.on_event(
+        {"event": EVENTS.STREAM_PREFETCH_DELIVER, "queue_depth": 2,
+         "capacity": 2},
+        1000.0,
+    )
+    det.evaluate(1020.0)
+    assert det.firing_keys() == []
+
+
+# -- QueuePinnedDetector -----------------------------------------------------
+
+
+def test_queue_pinned_fires_after_window_and_clears_below_capacity():
+    det = QueuePinnedDetector(window_s=5.0)
+    assert det.critical is False
+    det.on_event(
+        {"event": EVENTS.STREAM_PREFETCH_DELIVER, "queue_depth": 4,
+         "capacity": 4},
+        1000.0,
+    )
+    det.evaluate(1003.0)
+    assert det.firing_keys() == []      # pinned 3s < window
+    det.evaluate(1006.0)
+    fired = dict(det.firing_keys())
+    assert "queue" in fired and fired["queue"]["depth"] == 4
+    det.drain()
+    # one below-capacity sample clears immediately
+    det.on_event(
+        {"event": EVENTS.STREAM_PREFETCH_DELIVER, "queue_depth": 3,
+         "capacity": 4},
+        1007.0,
+    )
+    det.evaluate(1007.5)
+    assert det.firing_keys() == []
+    assert [t["status"] for t in det.drain()] == ["cleared"]
+
+
+# -- DegradedSpikeDetector ---------------------------------------------------
+
+
+def test_degraded_spike_steady_rate_is_a_known_condition():
+    """A counter that has ALWAYS ticked at 5/s must not fire — the
+    spike threshold grades the fast rate against the slow baseline."""
+    det = DegradedSpikeDetector(counters=("c",), fast_window_s=5.0,
+                                slow_window_s=60.0, min_rate=1.0,
+                                spike_ratio=10.0)
+    for i in range(61):
+        det.observe("c", 5.0 * i, 1000.0 + i)   # steady 5/s
+    det.evaluate(1060.0)
+    assert det.firing_keys() == []
+
+
+def test_degraded_spike_burst_fires_and_clears():
+    det = DegradedSpikeDetector(counters=("c",), fast_window_s=5.0,
+                                slow_window_s=60.0, min_rate=1.0,
+                                spike_ratio=10.0)
+    # near-flat for 55s, then +100 in the final 3s
+    for i in range(56):
+        det.observe("c", 0.0, 1000.0 + i)
+    for i in range(4):
+        det.observe("c", 25.0 * i, 1057.0 + i)
+    det.evaluate(1060.0)
+    fired = dict(det.firing_keys())
+    assert "c" in fired
+    assert fired["c"]["fast_rate"] > fired["c"]["baseline_rate"]
+    det.drain()
+    # the counter stops moving: fast rate decays to 0 and the key clears
+    for i in range(8):
+        det.observe("c", 75.0, 1061.0 + i)
+    det.evaluate(1069.0)
+    assert det.firing_keys() == []
+    assert [t["status"] for t in det.drain()] == ["cleared"]
+
+
+# -- HealthEngine ------------------------------------------------------------
+
+
+def test_engine_emits_typed_verdicts_and_mirrors_gauges():
+    """A manually-clocked engine pass emits the EVENTS-registered
+    verdict on the spine and mirrors a firing-count gauge."""
+    eng = HealthEngine(slo=parse_slo_spec("10,fast=5,slow=60"),
+                       detectors=[
+                           BurnRateDetector(parse_slo_spec("10,fast=5,slow=60"))
+                       ])
+    got = []
+    sub = telemetry.subscribe(got.append, name="t-health")
+    try:
+        for i in range(20):
+            eng._on_event(_latency(0.5, ts=1000.0 + i * 0.1))
+        out = eng.evaluate(now=1002.5)
+        assert any(
+            o["event"] == EVENTS.HEALTH_SLO_BURN
+            and o["status"] == "firing" for o in out
+        )
+        assert not eng.ok()
+        active = eng.active()
+        assert active and all(v["critical"] for v in active)
+        snap = telemetry.registry().snapshot()
+        assert snap["gauges"]["health.slo_burn.firing"]["last"] >= 1
+        # clear: windows empty after slow horizon
+        out = eng.evaluate(now=1002.5 + 61.0)
+        assert any(o["status"] == "cleared" for o in out)
+        assert eng.ok() and eng.active() == []
+        assert telemetry.registry().snapshot()["gauges"][
+            "health.slo_burn.firing"
+        ]["last"] == 0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            stats = {r.get("status") for r in got
+                     if r.get("event") == EVENTS.HEALTH_SLO_BURN}
+            if {"firing", "cleared"} <= stats:
+                break
+            time.sleep(0.01)
+        assert {"firing", "cleared"} <= stats
+    finally:
+        telemetry.unsubscribe(sub)
+
+
+def test_engine_ignores_its_own_verdict_events():
+    """health.* events must never feed back into detectors."""
+
+    class Probe(health._Detector):
+        event = EVENTS.HEALTH_QUEUE_PINNED
+        seen: list = []
+
+        def on_event(self, rec, now):
+            self.seen.append(rec["event"])
+
+        def evaluate(self, now):
+            pass
+
+    probe = Probe()
+    eng = HealthEngine(detectors=[probe])
+    eng._on_event({"event": EVENTS.HEALTH_SLO_BURN, "status": "firing",
+                   "ts": 1.0})
+    eng._on_event({"event": EVENTS.HEALTH_FLIGHT_DUMP, "ts": 1.0})
+    eng._on_event({"event": EVENTS.STREAM_COMMIT, "ts": 1.0})
+    assert probe.seen == [EVENTS.STREAM_COMMIT]
+
+
+def test_engine_noncritical_detector_keeps_health_ok():
+    """queue_pinned / degraded_spike grade but do not 503."""
+    det = QueuePinnedDetector(window_s=5.0)
+    eng = HealthEngine(detectors=[det])
+    eng._on_event(
+        {"event": EVENTS.STREAM_PREFETCH_DELIVER, "queue_depth": 4,
+         "capacity": 4, "ts": 1000.0}
+    )
+    eng.evaluate(now=1006.0)
+    assert eng.active() and eng.ok()  # firing, but not critical
+
+
+def test_engine_watchdog_trip_dumps_flight_recorder_once_per_stage():
+    class StubRecorder:
+        def __init__(self):
+            self.reasons = []
+
+        def dump(self, reason=None, **kw):
+            self.reasons.append(reason)
+
+    rec = StubRecorder()
+    det = StallWatchdog(timeout_s=5.0, min_events=3)
+    eng = HealthEngine(detectors=[det], recorder=rec)
+    _feed_stall(det, 1000.0)
+    eng.evaluate(now=1006.0)
+    eng.evaluate(now=1007.0)  # still stalled: must NOT dump again
+    assert rec.reasons == ["watchdog:hash"]
+
+
+def test_engine_spec_config_reaches_detectors():
+    eng = HealthEngine(slo=parse_slo_spec("10,stall=2,tick=0.05"))
+    assert eng.tick_s == 0.05
+    stalls = [d for d in eng.detectors if isinstance(d, StallWatchdog)]
+    pinned = [d for d in eng.detectors
+              if isinstance(d, QueuePinnedDetector)]
+    assert stalls[0].timeout_s == 2.0 and pinned[0].window_s == 2.0
+    with pytest.raises(ValueError):
+        HealthEngine(tick_s=0.0)
+
+
+def test_engine_close_is_idempotent_and_detaches():
+    eng = HealthEngine(slo=parse_slo_spec("10")).start()
+    assert telemetry.enabled()
+    eng.close()
+    eng.close()
+    assert not telemetry.enabled()
+
+
+# -- FlightRecorder ----------------------------------------------------------
+
+
+def test_flight_recorder_ring_is_bounded_oldest_first():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec({"event": "e", "i": i})
+    snap = rec.snapshot()
+    assert [r["i"] for r in snap] == [6, 7, 8, 9]
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_flight_recorder_dump_format_and_health_section(tmp_path):
+    rec = FlightRecorder(capacity=8)
+    rec({"event": EVENTS.STREAM_COMMIT, "row": 1, "ts": 10.0})
+    assert rec.dump() is None  # no path known yet
+    path = str(tmp_path / "dump.json")
+    rec.attach_health(lambda: [{"detector": "health.stall", "key": "h2d",
+                                "critical": True}])
+    out = rec.dump(path, reason="on_demand")
+    assert out == path
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["format"] == FlightRecorder.FORMAT
+    assert dump["v"] == FlightRecorder.VERSION
+    assert dump["pid"] == os.getpid()
+    assert dump["reason"] == "on_demand"
+    assert dump["capacity"] == 8
+    assert dump["events"][0]["row"] == 1
+    assert "counters" in dump
+    assert dump["health"][0]["key"] == "h2d"
+    # no leftover tmp file: the write is tmp -> fsync -> replace
+    assert [p.name for p in tmp_path.iterdir()] == ["dump.json"]
+
+
+def test_flight_recorder_install_uninstall_restores_dispositions(
+    tmp_path,
+):
+    rec = FlightRecorder()
+    prev_sig = signal.getsignal(signal.SIGUSR1)
+    prev_hook = sys.excepthook
+    rec.install(str(tmp_path / "d.json"), signals=(signal.SIGUSR1,))
+    try:
+        assert signal.getsignal(signal.SIGUSR1) is not prev_sig
+        assert sys.excepthook is not prev_hook
+    finally:
+        rec.uninstall()
+    assert signal.getsignal(signal.SIGUSR1) is prev_sig
+    assert sys.excepthook is prev_hook
+    rec.uninstall()  # idempotent
+
+
+# -- doctor --postmortem -----------------------------------------------------
+
+
+def test_build_postmortem_names_open_span_stage(tmp_path):
+    """The stage with a span still OPEN in the ring wins last-active,
+    even when another stage heartbeated later."""
+    rec = FlightRecorder()
+    sub = telemetry.subscribe(rec, name="t-pm")
+    try:
+        with telemetry.span("hash", new_trace=True):
+            pass
+        telemetry.emit(EVENTS.SPAN_START, name="dispatch", span_id="s1",
+                       trace_id="t1")
+        with telemetry.span("enqueue_wait", new_trace=True):
+            pass
+        deadline = time.monotonic() + 5.0
+        while len(rec.snapshot()) < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        telemetry.unsubscribe(sub)
+    path = str(tmp_path / "d.json")
+    rec.dump(path, reason="on_demand")
+    with open(path) as f:
+        pm = build_postmortem(json.load(f))
+    assert pm["last_active_stage"] == "dispatch"
+    assert any(s["name"] == "dispatch" for s in pm["in_flight"])
+    stages = {r["stage"] for r in pm["stages"]}
+    assert {"hash", "dispatch", "enqueue_wait"} <= stages
+    text = render_postmortem(pm)
+    assert "last active stage: dispatch" in text
+    assert "spans in flight at death:" in text
+
+
+def test_build_postmortem_rejects_foreign_artifact():
+    with pytest.raises(ValueError):
+        build_postmortem({"format": "topk_slo", "events": []})
+
+
+# -- GET /health over real HTTP ---------------------------------------------
+
+
+def _http_health(port):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=5.0
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_health_endpoint_without_engine_is_honest():
+    srv = metrics_server.MetricsServer(port=0)
+    try:
+        code, body = _http_health(srv.port)
+        assert code == 200
+        assert body == {"ok": True, "attached": False, "active": []}
+    finally:
+        srv.close()
+
+
+def test_health_endpoint_503_while_critical_fires_then_recovers():
+    eng = HealthEngine(
+        detectors=[BurnRateDetector(parse_slo_spec("10,fast=5,slow=60"))]
+    )
+    srv = metrics_server.MetricsServer(port=0, health=eng)
+    try:
+        for i in range(20):
+            eng._on_event(_latency(0.5, ts=1000.0 + i * 0.1))
+        eng.evaluate(now=1002.5)
+        code, body = _http_health(srv.port)
+        assert code == 503
+        assert body["ok"] is False and body["attached"] is True
+        assert body["active"][0]["detector"] == EVENTS.HEALTH_SLO_BURN
+        eng.evaluate(now=1002.5 + 61.0)
+        code, body = _http_health(srv.port)
+        assert code == 200 and body["ok"] is True and body["active"] == []
+    finally:
+        srv.close()
+
+
+# -- drop-never-block with the engine subscribed -----------------------------
+
+
+def test_engine_subscription_never_blocks_the_emitter():
+    """Same bound as the r17 pin (tests/test_live_plane.py): with a
+    real started HealthEngine folding every event, 500 emits stay under
+    2s wall — the hot path pays only a put_nowait."""
+    eng = HealthEngine(slo=parse_slo_spec("10,fast=1,slow=2")).start()
+    try:
+        n = 500
+        t0 = time.perf_counter()
+        for i in range(n):
+            telemetry.emit(
+                EVENTS.SERVE_LATENCY_REQUEST, total_s=0.5, server="topk"
+            )
+        emit_wall = time.perf_counter() - t0
+        assert emit_wall < 2.0, f"emit path blocked: {emit_wall:.3f}s"
+    finally:
+        eng.close()
+
+
+# -- subprocess kill leg -----------------------------------------------------
+
+
+def test_sigterm_mid_stream_bench_leaves_renderable_postmortem(tmp_path):
+    """The kill-matrix leg: SIGTERM a real stream-bench --flight-dump
+    run mid-flight; the process must die BY the signal (exit -15, not a
+    clean 0 that would fool a supervisor), the dump must parse, and the
+    postmortem must name a real pipeline stage."""
+    dump_path = str(tmp_path / "dump.json")
+    jsonl = str(tmp_path / "ev.jsonl")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "randomprojection_tpu", "stream-bench",
+            "--rows", "80000000", "--d", "256", "--k", "32",
+            "--batch-rows", "8192", "--backend", "numpy",
+            "--prefetch-batches", "2", "--flight-dump", dump_path,
+            "--telemetry-jsonl", jsonl,
+        ],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            assert proc.poll() is None, (
+                f"stream-bench exited rc={proc.returncode} before kill"
+            )
+            if os.path.exists(jsonl) and os.path.getsize(jsonl) > 4096:
+                break
+            time.sleep(0.1)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert rc == -signal.SIGTERM
+    with open(dump_path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "signal:SIGTERM"
+    assert dump["events"], "ring dumped empty mid-flight"
+    pm = build_postmortem(dump)
+    assert pm["last_active_stage"] in (
+        "hash", "enqueue_wait", "h2d", "dispatch", "d2h", "batch"
+    )
+    render_postmortem(pm)  # must not raise

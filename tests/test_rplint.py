@@ -230,6 +230,44 @@ def test_rp03_rp10_scope_includes_live_plane_modules():
         assert mod in rplint.CONCURRENCY_MODULES
 
 
+def test_rp02_unregistered_health_event_fixture():
+    """ISSUE r20 satellite: rogue ``health.*`` emits are caught against
+    the REAL shipped registry — the health namespace has no family
+    prefix, so each verdict/dump event must be individually registered,
+    and the registered burn/dump events in the same fixture stay
+    clean."""
+    real = rplint.load_event_registry(
+        open(os.path.join(
+            rplint.package_root(), "utils", "telemetry.py"
+        )).read()
+    )
+    assert real is not None
+    assert real.knows("health.slo_burn")
+    assert real.knows("health.stall")
+    assert real.knows("health.queue_pinned")
+    assert real.knows("health.degraded_spike")
+    assert real.knows("health.flight_dump")
+    assert not real.knows("health.rogue_burn")
+    active, suppressed = _split(
+        _lint_fixture("rp02_health_bad.py", registry=real)
+    )
+    assert [f.rule for f in active] == ["RP02"] * 2
+    msgs = " | ".join(f.message for f in active)
+    assert "'health.rogue_burn'" in msgs
+    assert "'health.rogue_dump'" in msgs
+    assert not suppressed
+
+
+def test_rp03_rp10_scope_includes_health_plane_module():
+    """ISSUE r20 satellite: the health engine's event fold and tick
+    loop run process-long beside the serving path, and its lock is
+    shared by the subscriber-dispatch and tick threads — it belongs to
+    the hot, pipeline and concurrency sets."""
+    assert "utils/health.py" in rplint.HOT_MODULES
+    assert "utils/health.py" in rplint.PIPELINE_MODULES
+    assert "utils/health.py" in rplint.CONCURRENCY_MODULES
+
+
 def test_rp04_zero_and_negative_maxsize_are_unbounded():
     """Python treats any maxsize <= 0 as unbounded — every spelling of
     that must trip RP04, not just the bare constructor."""

@@ -57,6 +57,7 @@ TOY_SHAPES = [
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("rows,nb,nq,m,tile", TOY_SHAPES)
 def test_fused_vs_scan_vs_bruteforce(rows, nb, nq, m, tile):
     rng = _rng(rows + nb)
@@ -66,6 +67,7 @@ def test_fused_vs_scan_vs_bruteforce(rows, nb, nq, m, tile):
     _three_way(idx, A, m, _filtered_reference(A, B, m), tile=tile)
 
 
+@pytest.mark.slow
 def test_parity_with_tombstones_and_chunks():
     """Multi-chunk index with tombstones in some chunks only: the
     masked fused variant runs beside the unmasked one and both match
@@ -85,6 +87,7 @@ def test_parity_with_tombstones_and_chunks():
     _three_way(idx, A, m, _filtered_reference(A, B, m, dead))
 
 
+@pytest.mark.slow
 def test_parity_tie_heavy_boundary_ids():
     """A corpus of few distinct codes: almost every selection decision
     is a tie, broken by the LOWER global id — including ties that
@@ -99,6 +102,7 @@ def test_parity_tie_heavy_boundary_ids():
     _three_way(idx, A, m, _filtered_reference(A, B, m))
 
 
+@pytest.mark.slow
 def test_parity_ragged_last_block_and_nbits():
     """Rows that leave a ragged last block at every block size the plan
     can pick, plus a ragged bit width (pad bits cancel)."""
@@ -114,6 +118,7 @@ def test_parity_ragged_last_block_and_nbits():
     _three_way(idx, A, m, _filtered_reference(A, B, m))
 
 
+@pytest.mark.slow
 def test_m_above_old_int32_key_ceiling_served_on_device():
     """THE ceiling-removal acceptance (ISSUE 7): a request the old
     packed-key bound rejected — ``(n_bits+2)·(m+blk) ≥ 2^31`` even at
@@ -273,8 +278,7 @@ def test_topk_impl_validation_and_env_override(monkeypatch):
     assert idx._chunk_impl(4, 64, 3) == "scan"
     monkeypatch.delenv("RP_TOPK_IMPL")
     assert idx._chunk_impl(4, 64, 3) == "fused"
-
-
+@pytest.mark.slow
 def test_kernel_dispatch_event_on_spine(tmp_path):
     """The fused path records ``topk.kernel.dispatch`` events that the
     doctor consumes into its serving section."""
