@@ -50,6 +50,13 @@
 #                      device-fused probe path (ISSUE 16) bit-identical
 #                      to the host path (multi-chunk, tombstones,
 #                      ragged n_bits, 8-shard) via the same interpreter
+#   make tier-smoke    tiered hot/cold residency (ISSUE 19 / r21): a
+#                      corpus 4× an artificially capped HBM budget
+#                      answers bit-identically to a fully resident index
+#                      on the exact + LSH paths (tombstones, disk-tier
+#                      memmap spills, snapshot round-trip with verified
+#                      residency block, injected upload-failure rung,
+#                      8-shard all-cold merge)
 #   make recover-smoke subprocess kill/resume harness at toy shapes:
 #                      SIGKILL the durable ingest at every injected
 #                      point, restart, assert the recovered index is
@@ -79,10 +86,11 @@ PYTHON ?= python
 SMOKE_DIR := /tmp/rp_verify
 
 .PHONY: verify lint lint-ci tier1 kernel-smoke transform-smoke shard-smoke \
-        ann-smoke recover-smoke doctor-smoke live-smoke health-smoke
+        ann-smoke tier-smoke recover-smoke doctor-smoke live-smoke \
+        health-smoke
 
 verify: lint lint-ci kernel-smoke transform-smoke shard-smoke ann-smoke \
-        recover-smoke live-smoke health-smoke tier1 doctor-smoke
+        tier-smoke recover-smoke live-smoke health-smoke tier1 doctor-smoke
 
 lint:
 	$(PYTHON) -m randomprojection_tpu lint
@@ -140,6 +148,10 @@ shard-smoke:
 ann-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  $(PYTHON) -m randomprojection_tpu.ann.smoke
+
+tier-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) -m randomprojection_tpu.tier_smoke
 
 recover-smoke:
 	rm -rf $(SMOKE_DIR)_recover && mkdir -p $(SMOKE_DIR)_recover

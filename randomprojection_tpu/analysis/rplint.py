@@ -216,6 +216,10 @@ HOT_MODULES = (
     # whole process lifetime beside the serving path — a host sync or
     # swallowed error there silently blinds every detector
     "utils/health.py",
+    # r21 tiered residency: the stager/gather/fetch path runs per
+    # serving tile — a hidden host sync there re-serializes exactly the
+    # cold-upload/hot-kernel overlap the tier exists to provide
+    "tiering.py",
 )
 # RP06: modules on the pipeline/serving path where a swallowed error
 # strands a stream, a future, or a telemetry file
@@ -237,6 +241,9 @@ KERNEL_BUDGET_FNS = {
     "ops/pallas_kernels.py": "_reserved_bytes",
     "ops/topk_kernels.py": "plan_fused",
     "ops/probe_kernels.py": "plan_probe",
+    # r21 tiered residency: plan_residency owns the HBM-budget admission
+    # plan (hot set + bounded staging headroom) the tier serves under
+    "tiering.py": "plan_residency",
 }
 KERNEL_MODULES = tuple(KERNEL_BUDGET_FNS)
 # RP10/RP11 (ISSUE 12): the modules where threads and locks meet — the
@@ -259,6 +266,11 @@ CONCURRENCY_MODULES = (
     # dispatch thread (event fold) and the tick thread (evaluate) — the
     # emit-outside-lock contract is exactly what RP10/RP11 police
     "utils/health.py",
+    # r21 tiered residency: the manager lock is taken by serving threads
+    # (admission, access accounting) and the promotion/demotion worker
+    # (residency swaps) — emit-outside-lock and never-put-under-lock are
+    # exactly its correctness story
+    "tiering.py",
 )
 # RP05: Generator-construction surface of np.random that stays legal
 RNG_FACTORY_OK = frozenset(
@@ -269,7 +281,11 @@ RNG_FACTORY_OK = frozenset(
 # somewhere observable (record_vmem_oom_retry is the shared degraded-
 # retry recorder — it emits + counts for both VMEM-OOM call sites)
 RP06_MITIGATORS = frozenset(
-    {"emit", "counter_inc", "end_span", "record_vmem_oom_retry"}
+    {"emit", "counter_inc", "end_span", "record_vmem_oom_retry",
+     # r21: the tiered residency layer's shared degraded-rung recorder —
+     # emits index.tier.fallback AND bumps the fallback counter, the
+     # same emit+count contract as record_vmem_oom_retry
+     "note_fallback"}
 )
 
 _PRAGMA_RE = re.compile(r"#\s*rplint:\s*(.*)$")

@@ -809,11 +809,26 @@ class LSHSimHashIndex(SimHashIndex):
         OOM, or a shape the planner cannot tile, degrades to one device
         Hamming dispatch + host select — same (dist, lower-id) order,
         same results (the candidate set is small by construction, the
-        density gate bounds it)."""
+        density gate bounds it).
+
+        With a tiered index holding cold chunks, the candidate set
+        splits by residency and the cold side's rows stream H2D under
+        the hot side's kernel (``_lsh_rerank_tiered``) — bit-identical
+        by the union-of-top-m identity the adaptive tier already relies
+        on."""
+        a = self._device_queries(a_np)
+        if self._tier is not None and self._tier.any_cold():
+            return self._lsh_rerank_tiered(a, a_np, cand, m_eff)
+        return self._lsh_rerank_one(
+            a, a_np, cand, self._gather_codes_device(cand), m_eff
+        )
+
+    def _lsh_rerank_one(self, a, a_np, cand: np.ndarray, cand_dev,
+                        m_eff: int):
+        """One re-rank dispatch against one gathered candidate plane
+        (the whole tile's union, or one residency side of it)."""
         from randomprojection_tpu.ops import topk_kernels
 
-        a = self._device_queries(a_np)
-        cand_dev = self._gather_codes_device(cand)
         n_cand = int(cand.size)
         shape_key = (int(a_np.shape[0]), int(cand_dev.shape[0]), m_eff)
         plan = None
@@ -846,11 +861,119 @@ class LSHSimHashIndex(SimHashIndex):
         _start_host_copy(d)
         return ("host", d, None, cand)
 
+    def _lsh_rerank_tiered(self, a, a_np, cand: np.ndarray, m_eff: int):
+        """The tentpole dispatch (ISSUE 19): split the candidate union
+        by chunk residency, start the cold rows' asynchronous H2D
+        upload FIRST, dispatch the hot-tier re-rank (the upload streams
+        under that kernel), then dispatch the cold-tier re-rank against
+        the landed rows.  Each side selects its own top-``min(m_eff,
+        side)`` and ``_lsh_finish_tile`` merges the planes under the
+        documented (distance, lower-global-id) order — exact by the
+        union-of-top-m identity, and full because the starved gate
+        already guaranteed ``|hot| + |cold| ≥ m_eff``.  A failed
+        staging upload degrades to committing the host rows at dispatch
+        (synchronous fetch, degraded audit) — never wrong answers."""
+        from randomprojection_tpu.ops import topk_kernels
+        from randomprojection_tpu.parallel.sharded import row_bucket
+
+        tier = self._tier
+        t0 = time.perf_counter()
+        hot_mask = np.zeros(cand.size, bool)
+        per_chunk: dict = {}
+        cold_parts = []
+        base = 0
+        for c in self._chunks:
+            lo = np.searchsorted(cand, base)
+            hi = np.searchsorted(cand, base + c.n)
+            if hi > lo:
+                per_chunk[c.row0] = int(hi - lo)
+                if tier.chunk_is_hot(c):
+                    hot_mask[lo:hi] = True
+                else:
+                    # the host-side cold fetch: a RAM copy for the host
+                    # tier, touched pages only for a disk-tier memmap
+                    local = (cand[lo:hi] - base).astype(np.int64)
+                    # c.b is HOST-resident by the cold-tier invariant
+                    # (ndarray or memmap): this asarray is a host
+                    # gather, not a device sync, and its rows feed the
+                    # async stage_rows upload below
+                    cold_parts.append(np.asarray(c.b)[local])  # rplint: allow[RP03] — host gather of a host-resident cold chunk, no device sync
+            base += c.n
+        cand_hot = cand[hot_mask]
+        cand_cold = cand[~hot_mask]
+        tier.note_gather(int(cand_hot.size), int(cand_cold.size),
+                         per_chunk)
+        if cand_cold.size == 0:
+            # the whole union is hot (residency races included): the
+            # fully resident dispatch serves unchanged
+            return self._lsh_rerank_one(
+                a, a_np, cand, self._gather_codes_device(cand), m_eff
+            )
+        cold_rows = (cold_parts[0] if len(cold_parts) == 1
+                     else np.concatenate(cold_parts, axis=0))
+        pad_to = row_bucket(int(cand_cold.size))
+        sync = False
+        try:
+            cold_dev = topk_kernels.stage_rows(
+                cold_rows, device=self.device, pad_to=pad_to
+            )
+        except Exception as e:
+            tier.note_fallback(
+                f"upload:{type(e).__name__}", rows=int(cand_cold.size)
+            )
+            sync = True
+            cold_dev = np.zeros((pad_to, self.n_bytes), np.uint8)
+            cold_dev[: cold_rows.shape[0]] = cold_rows
+        wall_s = time.perf_counter() - t0
+        t_staged = time.perf_counter()
+        hot_payload = None
+        m_hot = 0
+        if cand_hot.size:
+            # hot-tier kernel dispatches while the cold upload streams
+            m_hot = int(min(m_eff, cand_hot.size))
+            hot_payload = self._lsh_rerank_one(
+                a, a_np, cand_hot,
+                self._gather_codes_device(cand_hot), m_hot,
+            )
+        # the window the upload had to hide under the hot dispatch
+        overlap_s = (time.perf_counter() - t_staged) if not sync else 0.0
+        m_cold = int(min(m_eff, cand_cold.size))
+        cold_payload = self._lsh_rerank_one(
+            a, a_np, cand_cold, cold_dev, m_cold
+        )
+        tier.note_fetch(
+            rows=int(cand_cold.size),
+            nbytes=int(cand_cold.size) * self.n_bytes, wall_s=wall_s,
+            overlap_s=overlap_s, source=tier.cold_tier, sync=sync,
+        )
+        return ("tiered", (hot_payload, m_hot), (cold_payload, m_cold))
+
     def _lsh_finish_tile(self, payload, m_eff: int):
         """Materialize one re-rank dispatch and map candidate-local
         positions back to global ids.  ``cand`` is ascending, so the
         kernel's lower-local-id tie-break IS the documented
-        lower-global-id order."""
+        lower-global-id order.
+
+        A ``'tiered'`` payload carries one sub-payload per residency
+        side: both finish through this same routine, pad to ``m_eff``
+        columns with the empty-slot sentinel pair, and merge under the
+        (distance, lower-global-id) key — the sides' candidate sets are
+        disjoint, so the merge's dedup only ever collapses sentinel
+        pads, and the starved gate guarantees ≥ ``m_eff`` real entries
+        in the union (the merged plane is always full)."""
+        if payload[0] == "tiered":
+            _, (hp, m_hot), (cp, m_cold) = payload
+            cd, ci = self._lsh_finish_tile(cp, m_cold)
+            if hp is None:
+                # all-cold tile: the gate guaranteed m_cold == m_eff
+                return cd, ci
+            hd, hi_ = self._lsh_finish_tile(hp, m_hot)
+            sent = np.int32(self.n_bits + 1)
+            if m_hot < m_eff:
+                pad = ((0, 0), (0, m_eff - m_hot))
+                hd = np.pad(hd, pad, constant_values=sent)
+                hi_ = np.pad(hi_, pad, constant_values=_INT32_MAX)
+            return _merge_topm_rows(hd, hi_, cd, ci, int(sent))
         kind, d, i, cand = payload
         if kind == "fused":
             # d2h already started at dispatch: these materialize the
@@ -876,6 +999,13 @@ class LSHSimHashIndex(SimHashIndex):
         path = (self.probe_path if probe_path is None
                 else _check_probe_path(probe_path))
         if path == "host":
+            return False
+        if self._tier is not None and self._tier.any_cold():
+            # the fused probe program gathers from EVERY chunk on device
+            # — with cold chunks that would re-upload whole chunks per
+            # dispatch, the exact cost tiering exists to avoid.  The
+            # host probe rung + tiered re-rank serves instead (same
+            # answers; the candidate rows stream, not the chunks).
             return False
         if path == "device":
             return True
@@ -1347,6 +1477,7 @@ class LSHShardedSimHashIndex(ShardedSimHashIndex):
             fallback_density=self.fallback_density,
             probe_path=self.probe_path, adaptive=self.adaptive,
             candidate_budget=self.candidate_budget,
+            **self._tier_kwargs(s),
         )
 
     def _lsh_global_keys(self) -> np.ndarray:

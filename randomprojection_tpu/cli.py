@@ -426,6 +426,19 @@ def build_parser():
     q.add_argument("--candidate-budget", type=int, default=0,
                    help="adaptive per-query candidate budget "
                         "(0 = uncapped)")
+    q.add_argument("--hbm-budget", type=int, default=0, metavar="BYTES",
+                   help="also measure the tiered hot/cold residency "
+                        "path (ISSUE 19 / r21): serve the same corpus "
+                        "through an index whose HBM budget is capped "
+                        "at this many bytes, cold chunks streaming in "
+                        "under the hot-tier kernel — reports hot-hit "
+                        "fraction, cold-fetch p99/overlap and q/s vs "
+                        "the resident run above (0 = skip)")
+    q.add_argument("--cold-tier", default="host",
+                   choices=["host", "disk"],
+                   help="where --hbm-budget's cold chunks live: pinned "
+                        "host RAM, or memmap-backed spill files in the "
+                        "r11 checksummed format")
     q.add_argument("--seed", type=int, default=0)
     _add_observability(q)
 
@@ -1195,6 +1208,92 @@ def cmd_topk_bench(args):
             **{f"lsh_{k}": v for k, v in lsh_index.lsh_stats().items()},
         }
 
+    tiered = None
+    if args.hbm_budget:
+        import shutil
+        import tempfile
+
+        from randomprojection_tpu.ops import topk_kernels
+        from randomprojection_tpu.utils import telemetry as _tel
+
+        # same corpus, ingested in 8 chunks so the budget splits it
+        # into a real hot/cold set; answers must stay bit-identical to
+        # the resident index above (the documented merge order)
+        chunk_rows = -(-args.index_codes // 8)
+        cold_dir = tempfile.mkdtemp(prefix="rp_tier_bench_") \
+            if args.cold_tier == "disk" else None
+        t_index = SimHashIndex(
+            codes[:0], topk_impl=args.topk_impl,
+            hbm_budget_bytes=args.hbm_budget,
+            cold_tier=args.cold_tier, cold_dir=cold_dir,
+        )
+        try:
+            for lo in range(0, args.index_codes, chunk_rows):
+                t_index.add(codes[lo : lo + chunk_rows])
+            rd, ri = index.query_topk(requests[0], args.m)
+            td, ti = t_index.query_topk(requests[0], args.m)  # + warm
+            parity_ok = bool((td == rd).all() and (ti == ri).all())
+            reg = _tel.registry()
+            h0 = reg.counter("index.tier.hot_rows")
+            c0 = reg.counter("index.tier.cold_rows")
+            f0 = reg.counter("index.tier.fetches")
+            fb0 = reg.counter("index.tier.fallbacks")
+            w0 = reg.hist_sum("index.tier.fetch_s")
+            o0 = reg.hist_sum("index.tier.overlap_s")
+            t0 = time.perf_counter()
+            for req in requests:
+                t_index.query_topk(req, args.m)
+            t_elapsed = time.perf_counter() - t0
+            hot = reg.counter("index.tier.hot_rows") - h0
+            cold = reg.counter("index.tier.cold_rows") - c0
+            fq = reg.hist_quantiles("index.tier.fetch_s")
+            chunk_tiers = [
+                c["tier"] for c in t_index._tier.residency()["chunks"]
+            ]
+            tiered = {
+                "hbm_budget_bytes": args.hbm_budget,
+                "cold_tier": args.cold_tier,
+                "over_budget_factor": round(
+                    args.index_codes * args.code_bytes / args.hbm_budget,
+                    2,
+                ),
+                "hot_chunks": sum(
+                    1 for t in chunk_tiers if t == "hot"
+                ),
+                "cold_chunks": sum(
+                    1 for t in chunk_tiers if t != "hot"
+                ),
+                "queries_per_s": round(
+                    len(requests) * args.request_rows / t_elapsed, 1
+                ),
+                "slowdown_vs_direct": round(
+                    direct_qps
+                    / (len(requests) * args.request_rows / t_elapsed),
+                    3,
+                ),
+                "hot_hit_fraction": (
+                    round(hot / (hot + cold), 4) if (hot + cold) else None
+                ),
+                "cold_fetches": reg.counter("index.tier.fetches") - f0,
+                "cold_fetch_wall_s": round(
+                    reg.hist_sum("index.tier.fetch_s") - w0, 6
+                ),
+                "cold_fetch_overlapped_s": round(
+                    reg.hist_sum("index.tier.overlap_s") - o0, 6
+                ),
+                "cold_fetch_p99_s": (
+                    round(fq["p99"], 6)
+                    if fq and fq.get("p99") is not None else None
+                ),
+                "fallbacks": reg.counter("index.tier.fallbacks") - fb0,
+                "parity_ok": parity_ok,
+                "timing_suspect": bool(topk_kernels.interpret_default()),
+            }
+        finally:
+            t_index.close()
+            if cold_dir is not None:
+                shutil.rmtree(cold_dir, ignore_errors=True)
+
     print(json.dumps({
         "metric": f"simhash top-k serving queries/s (m={args.m}, "
                   f"{args.index_codes} codes)",
@@ -1216,6 +1315,7 @@ def cmd_topk_bench(args):
         **{f"server_{k}": v for k, v in server.stats().items()},
         **({"sharded": sharded} if sharded else {}),
         **({"lsh": lsh} if lsh else {}),
+        **({"tiered": tiered} if tiered else {}),
     }))
     _write_openmetrics(args)
 

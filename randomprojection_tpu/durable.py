@@ -83,8 +83,13 @@ INDEX_FORMAT_VERSION = 1
 MANIFEST_NAME = "manifest.json"
 
 # fault-injection points, in pipeline order; RP_DURABLE_KILL="<point>@<n>"
-# SIGKILLs the process the n-th time that point is reached
-KILL_POINTS = ("mid-batch", "post-yield-pre-ack", "mid-snapshot-rename")
+# SIGKILLs the process the n-th time that point is reached.  The last
+# one lives in the tiered-residency demotion path (tiering.py): the
+# cold-tier spill file exists but the residency swap has not happened —
+# a crash there must leave the committed snapshot untouched and the
+# spill as sweepable debris.
+KILL_POINTS = ("mid-batch", "post-yield-pre-ack", "mid-snapshot-rename",
+               "mid-demotion")
 KILL_ENV = "RP_DURABLE_KILL"
 _kill_counts: dict = {}
 
@@ -351,6 +356,15 @@ def save_index(index, dirpath: str, *, ingest: Optional[dict] = None) -> dict:
     extra_hook = getattr(index, "_durable_extra", None)
     if extra_hook is not None:
         manifest.update(extra_hook(dirpath, gen))
+    # tiered residency (ISSUE 19): record the index's hot/cold placement
+    # at snapshot time.  The snapshot's chunk spills above already went
+    # through _fetch_chunk_host, which serves hot AND cold chunks alike,
+    # so the payload is residency-independent — the block is provenance
+    # and a verification surface (`cli recover` checks the tags), never
+    # a load-time requirement (a restore re-tiers under its own budget)
+    tier = getattr(index, "_tier", None)
+    if tier is not None:
+        manifest.update(tier.manifest_block())
     _commit_manifest(dirpath, manifest)
     # the new snapshot is committed: the previous generation's files are
     # now unreferenced debris (a crash before this sweep just leaves
@@ -364,6 +378,42 @@ def save_index(index, dirpath: str, *, ingest: Optional[dict] = None) -> dict:
         **({"rows_done": ingest["rows_done"]} if ingest else {}),
     )
     return manifest
+
+
+def _check_tier_block(manifest: dict) -> None:
+    """Validate a manifest's tiered-residency block (no-op when absent
+    — pre-tier snapshots simply load with everything hot).  Unknown
+    formats or tier tags fail LOUDLY: a silent skip would load a
+    snapshot whose residency provenance this reader cannot interpret."""
+    block = manifest.get("tier")
+    if block is None:
+        return
+    if block.get("format") != 1:
+        raise ValueError(
+            f"unknown tier-block format {block.get('format')!r} "
+            "(this reader understands format 1)"
+        )
+    from randomprojection_tpu.tiering import COLD_TIERS
+
+    if block.get("cold_tier") not in COLD_TIERS:
+        raise ValueError(
+            f"unknown cold_tier {block.get('cold_tier')!r} in tier "
+            f"block (expected one of {COLD_TIERS})"
+        )
+    known = ("hot",) + COLD_TIERS
+    rows_by_tag = {e["row0"]: e["rows"] for e in manifest["chunks"]}
+    for entry in block.get("chunks", []):
+        if entry.get("tier") not in known:
+            raise ValueError(
+                f"unknown residency tag {entry.get('tier')!r} for chunk "
+                f"row0={entry.get('row0')} (expected one of {known})"
+            )
+        if rows_by_tag.get(entry.get("row0")) != entry.get("rows"):
+            raise ValueError(
+                f"tier block names chunk row0={entry.get('row0')} "
+                f"rows={entry.get('rows')} but the manifest's chunk "
+                "table disagrees"
+            )
 
 
 def load_index(dirpath: str, *, mesh=None, data_axis: str = "data",
@@ -387,6 +437,7 @@ def load_index(dirpath: str, *, mesh=None, data_axis: str = "data",
 
     manifest = read_manifest(dirpath)
     check_coverage(manifest)
+    _check_tier_block(manifest)
     if manifest.get("id_offset"):
         # a plain SimHashIndex has no id-offset concept: loading would
         # silently renumber the corpus to 0-based ids — refuse and point
@@ -631,8 +682,32 @@ def _verify_manifest(dirpath: str, manifest: dict, status: dict) -> dict:
             if manifest.get("lsh")
             else None
         ),
+        "tier": (
+            {
+                "cold_tier": manifest["tier"].get("cold_tier"),
+                "hbm_budget_bytes":
+                    manifest["tier"].get("hbm_budget_bytes"),
+                "hot_chunks": sum(
+                    1 for e in manifest["tier"].get("chunks", [])
+                    if e.get("tier") == "hot"
+                ),
+                "cold_chunks": sum(
+                    1 for e in manifest["tier"].get("chunks", [])
+                    if e.get("tier") != "hot"
+                ),
+            }
+            if manifest.get("tier")
+            else None
+        ),
     })
     corrupt = []
+    try:
+        # residency metadata verifies like coverage: unknown tier tags
+        # or chunk-table disagreements are a corrupt manifest, reported
+        # (pre-tier snapshots have no block and verify unchanged)
+        _check_tier_block(manifest)
+    except ValueError as e:
+        corrupt.append({"file": MANIFEST_NAME, "error": str(e)})
     entries = list(manifest["chunks"])
     if manifest.get("tombstones"):
         entries.append(manifest["tombstones"])
@@ -914,7 +989,10 @@ def demo_ingest(path: str, *, rows: int = 192, batch_rows: int = 32,
     ``DurableIngest``) whose every byte is a pure function of the
     arguments — so a killed-and-resumed run can be compared
     bit-for-bit against a clean one.  Returns a summary dict."""
-    from randomprojection_tpu.models.sketch import SignRandomProjection
+    from randomprojection_tpu.models.sketch import (
+        SignRandomProjection,
+        SimHashIndex,
+    )
     from randomprojection_tpu.streaming import CallableSource
 
     def read(lo, hi):
@@ -928,11 +1006,30 @@ def demo_ingest(path: str, *, rows: int = 192, batch_rows: int = 32,
     ingest = DurableIngest(path, commit_every_batches=commit_every,
                            compact_after_chunks=compact_after)
     index = ingest.run(est, source)
+    # tiered-demotion fault leg (ISSUE 19): re-open the committed codes
+    # as a disk-tiered index (spills in a subdirectory the orphan sweep
+    # never enters) and synchronously demote every chunk — each pass
+    # crosses the "mid-demotion" kill point between the spill write and
+    # the residency swap, proving a crash there leaves the committed
+    # snapshot loadable with the spill as debris (the kill matrix's
+    # resume re-runs this leg cleanly)
+    tiered = SimHashIndex(
+        np.empty((0, index.n_bytes), np.uint8), n_bits=index.n_bits,
+        hbm_budget_bytes=1 << 40, cold_tier="disk",
+        cold_dir=os.path.join(path, "cold"),
+    )
+    for chunk in index._chunks:
+        tiered.add(index._fetch_chunk_host(chunk))
+    demoted = sum(
+        tiered._tier.demote(c.row0) for c in tiered._chunks
+    )
+    tiered.close()
     return {
         "path": path,
         "rows_done": ingest.rows_done(),
         "n_codes": int(index.n_codes),
         "chunks": len(index._chunks),
+        "tier_demotions": int(demoted),
     }
 
 

@@ -309,7 +309,9 @@ class SimHashIndex:
 
     def __init__(self, codes, *, mesh=None, data_axis: str = "data",
                  n_bits: Optional[int] = None, topk_impl: str = "auto",
-                 device=None, label: Optional[str] = None):
+                 device=None, label: Optional[str] = None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 cold_tier: str = "host", cold_dir: Optional[str] = None):
         if topk_impl not in self._TOPK_IMPLS:
             raise ValueError(
                 f"topk_impl must be one of {self._TOPK_IMPLS}, "
@@ -319,6 +321,12 @@ class SimHashIndex:
             raise ValueError(
                 "device= pins a single-device index; it cannot combine "
                 "with mesh= (one index is one shard OR one shard_map span)"
+            )
+        if hbm_budget_bytes is not None and mesh is not None:
+            raise ValueError(
+                "hbm_budget_bytes= tiers a single-device index; the mesh "
+                "path shards residency across devices instead (tier the "
+                "per-shard indexes of serving.ShardedSimHashIndex)"
             )
         self.mesh = mesh
         self.data_axis = data_axis
@@ -359,6 +367,19 @@ class SimHashIndex:
         self._dead: Optional[np.ndarray] = None
         self._n_deleted = 0
         self._dead_rev = 0
+        # tiered hot/cold residency (ISSUE 19 / r21): None = every chunk
+        # device-resident (the pre-r21 path, zero new cost); set = chunks
+        # past the HBM budget live host- or disk-resident and the serving
+        # paths stream their candidate rows H2D under the hot-tier kernel
+        # (see tiering.TieredResidency)
+        self._tier = None
+        if hbm_budget_bytes is not None:
+            from randomprojection_tpu.tiering import TieredResidency
+
+            self._tier = TieredResidency(
+                int(hbm_budget_bytes), cold_tier=cold_tier,
+                cold_dir=cold_dir, device_put=self._device_queries,
+            )
         if codes.shape[0]:
             self._upload_chunk(codes)
 
@@ -395,8 +416,15 @@ class SimHashIndex:
                 "(serving.ShardedSimHashIndex keeps global ids int64 and "
                 "this bound per shard)"
             )
+        hot = True
         if self.mesh is None:
-            if self.device is not None:
+            if self._tier is not None and not self._tier.admit(codes.nbytes):
+                # past the HBM budget: the chunk lands cold (host array
+                # or checksummed disk spill) and its candidate rows
+                # stream H2D per query instead of residing
+                b = self._tier.place_cold(codes)
+                hot = False
+            elif self.device is not None:
                 b = jax.device_put(codes, self.device)
             else:
                 b = jnp.asarray(codes)
@@ -414,7 +442,10 @@ class SimHashIndex:
             b = jax.device_put(
                 codes, NamedSharding(self.mesh, P(self.data_axis, None))
             )
-        self._chunks.append(_IndexChunk(b, n, self.n_codes))
+        chunk = _IndexChunk(b, n, self.n_codes)
+        self._chunks.append(chunk)
+        if self._tier is not None:
+            self._tier.register(chunk, n * self.n_bytes, hot)
         if self._dead is not None:
             self._dead = np.concatenate(
                 [self._dead, np.zeros(n, dtype=bool)]
@@ -584,6 +615,11 @@ class SimHashIndex:
         device fetch ``compact()`` would pay).  The caller guarantees
         ``codes`` is the live code set in id order."""
         old_n, old_chunks = self.n_codes, len(self._chunks)
+        if self._tier is not None:
+            # forget residency (and unlink this generation's spill
+            # files) before the re-upload re-registers the new chunk —
+            # the caller already guarantees quiescence here
+            self._tier.reset()
         self._chunks = []
         self.n_codes = 0
         self._dead = None
@@ -597,6 +633,14 @@ class SimHashIndex:
             chunks_after=len(self._chunks), n_codes=self.n_codes,
             dropped=int(old_n - self.n_codes),
         )
+
+    def close(self) -> None:
+        """Release background resources: joins the tiered-residency
+        worker when one exists (no-op otherwise, idempotent).  Untiered
+        indexes need no close; tiered ones should close before process
+        exit so in-flight promotions/demotions finish cleanly."""
+        if self._tier is not None:
+            self._tier.close()
 
     # -- durable snapshot/restore (ISSUE 6; see durable.py) ------------------
 
@@ -835,13 +879,27 @@ class SimHashIndex:
         shard's compute (dispatch is async; a dispatch-then-fetch loop
         per shard would serialize the whole mesh)."""
         a = self._device_queries(a_np)
+        stager = None
+        if self._tier is not None and self._tier.any_cold():
+            from randomprojection_tpu.tiering import _TileStager
+
+            stager = _TileStager(
+                self._chunks, self._tier, self._device_queries
+            )
         handles = []
-        for c in self._chunks:
+        for ci, c in enumerate(self._chunks):
             m_c = int(min(m_eff, c.n))
-            d, i = self._chunk_topk(a, c, m_c)
+            # the stager resolves a cold chunk to its staged device copy
+            # (upload started while the PREVIOUS chunk's kernel ran) and
+            # starts the next cold chunk's upload before this kernel
+            # dispatches — the H2D streams under the hot-tier compute
+            b = stager.resolve(ci) if stager is not None else None
+            d, i = self._chunk_topk(a, c, m_c, b=b)
             _start_host_copy(d)
             _start_host_copy(i)
             handles.append((d, i))
+        if stager is not None:
+            stager.finish(int(a_np.shape[0]))
         telemetry.registry().counter_inc(
             "simhash.chunk_dispatches", len(self._chunks)
         )
@@ -961,7 +1019,7 @@ class SimHashIndex:
                 return "dense"
         return "device"
 
-    def _chunk_topk(self, a, chunk, m_c: int):
+    def _chunk_topk(self, a, chunk, m_c: int, b=None):
         """Device top-``m_c`` of one chunk for one query tile.  Returns
         ``(dist, local_idx)`` of shape ``(t, m_c)`` (mesh: ``(t, p·m_c)``
         — per-shard candidates, ids already chunk-global).  Pad rows —
@@ -976,8 +1034,13 @@ class SimHashIndex:
         process lifetime."""
         import jax.numpy as jnp
 
+        # b overrides the chunk's resident array (the tiered exact path
+        # passes a pre-staged device copy of a cold chunk); shapes are
+        # identical by construction, so every route below is unchanged
+        if b is None:
+            b = chunk.b
         dead = self._chunk_dead_device(chunk)
-        nq, rows_pad = a.shape[0], chunk.b.shape[0]
+        nq, rows_pad = a.shape[0], b.shape[0]
         mode = None
         if self.mesh is None and self._topk_impl_pref() != "scan":
             mode = self._fused_mode(nq, rows_pad, m_c)
@@ -991,7 +1054,7 @@ class SimHashIndex:
 
             plan, degraded = mode
             try:
-                return self._dispatch_fused(a, chunk, m_c, dead, plan)
+                return self._dispatch_fused(a, chunk, m_c, dead, plan, b=b)
             except Exception as e:
                 if not is_vmem_oom(e) or degraded:
                     # unclassified failures surface; a second OOM at the
@@ -1016,7 +1079,7 @@ class SimHashIndex:
                     # the old int32-key ceiling rejected): degrade
                     # WITHIN the kernel to the minimal-VMEM tiling
                     return self._dispatch_fused(
-                        a, chunk, m_c, dead, retry[0]
+                        a, chunk, m_c, dead, retry[0], b=b
                     )
                 # else the scan path serves this dispatch (and this
                 # shape, for the process lifetime)
@@ -1024,20 +1087,22 @@ class SimHashIndex:
             a.shape, rows_pad, m_c, masked=dead is not None
         )
         if dead is not None:
-            return fn(a, chunk.b, jnp.int32(chunk.n), dead)
-        return fn(a, chunk.b, jnp.int32(chunk.n))
+            return fn(a, b, jnp.int32(chunk.n), dead)
+        return fn(a, b, jnp.int32(chunk.n))
 
-    def _dispatch_fused(self, a, chunk, m_c: int, dead, plan):
+    def _dispatch_fused(self, a, chunk, m_c: int, dead, plan, b=None):
         from randomprojection_tpu.ops import topk_kernels
 
+        if b is None:
+            b = chunk.b
         d, i = topk_kernels.fused_topk(
-            a, chunk.b, chunk.n, m_c, dead=dead, plan=plan
+            a, b, chunk.n, m_c, dead=dead, plan=plan
         )
         if telemetry.enabled():
             telemetry.emit(
                 EVENTS.TOPK_KERNEL_DISPATCH,
                 queries=int(a.shape[0]), m=int(m_c),
-                rows=int(chunk.b.shape[0]),
+                rows=int(b.shape[0]),
                 masked=dead is not None,
                 **telemetry.trace_fields(),
             )

@@ -64,7 +64,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["TopkPlan", "plan_fused", "fused_topk", "interpret_default"]
+__all__ = [
+    "TopkPlan",
+    "plan_fused",
+    "fused_topk",
+    "interpret_default",
+    "stage_rows",
+]
 
 # Mosaic's scoped-VMEM limit and the measured temporary headroom — same
 # constants as ops/pallas_kernels.py (kept local: the two kernels budget
@@ -506,3 +512,34 @@ def fused_topk(q, codes, n_real, m: int, *, dead=None,
         q, codes, jnp.int32(n_real), dead, plan=plan, n_bytes=n_bytes,
         m=int(m), interpret=bool(interpret), masked=masked,
     )
+
+
+def stage_rows(rows, *, device=None, pad_to: Optional[int] = None):
+    """Tier-boundary H2D staging (ISSUE 19 / r21): start the upload of
+    host-gathered candidate rows and return the device handle WITHOUT
+    waiting for the transfer.  ``jax.device_put`` is asynchronous — the
+    copy streams in the background and the first kernel that consumes
+    the handle joins it — so a caller that stages its cold-tier rows
+    *before* dispatching the hot-tier re-rank gets the upload for free
+    under that kernel's compute (the in-kernel DMA double-buffering
+    idiom applied at the tier boundary).
+
+    ``pad_to`` zero-pads on the HOST before the put (one contiguous
+    transfer, no device-side pad dispatch) so the fused re-rank
+    compiles one program per row bucket, exactly like the resident
+    gather path.  ``device=None`` targets the platform default."""
+    import numpy as np
+
+    rows = np.asarray(rows, dtype=np.uint8)
+    if pad_to is not None and pad_to != rows.shape[0]:
+        if pad_to < rows.shape[0]:
+            raise ValueError(
+                f"stage_rows pad_to={pad_to} below row count "
+                f"{rows.shape[0]}"
+            )
+        padded = np.zeros((pad_to, rows.shape[1]), np.uint8)
+        padded[: rows.shape[0]] = rows
+        rows = padded
+    if device is not None:
+        return jax.device_put(rows, device)
+    return jnp.asarray(rows)

@@ -144,7 +144,9 @@ class ShardedSimHashIndex:
     def __init__(self, codes, *, mesh=None, devices=None,
                  n_shards: Optional[int] = None, data_axis: str = "data",
                  n_bits: Optional[int] = None, topk_impl: str = "auto",
-                 id_offset: int = 0):
+                 id_offset: int = 0,
+                 hbm_budget_bytes: Optional[int] = None,
+                 cold_tier: str = "host", cold_dir: Optional[str] = None):
         codes = np.asarray(codes, dtype=np.uint8)
         if codes.ndim != 2:
             raise ValueError(f"codes must be (n, nbytes), got {codes.shape}")
@@ -161,6 +163,13 @@ class ShardedSimHashIndex:
         self.id_offset = int(id_offset)
         self.topk_impl = topk_impl
         self.data_axis = data_axis
+        # tiered residency (ISSUE 19 / r21): the budget is PER SHARD —
+        # each shard tiers its own device's HBM independently, so the
+        # aggregate hot capacity scales with the device count while the
+        # knob stays one number per device, matching how HBM is owned
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.cold_tier = cold_tier
+        self.cold_dir = cold_dir
         self._devices = shard_devices(mesh, devices, n_shards, data_axis)
         self._shards = [
             self._make_shard(s, dev)
@@ -187,7 +196,30 @@ class ShardedSimHashIndex:
             np.empty((0, self.n_bytes), np.uint8),
             n_bits=self.n_bits, topk_impl=self.topk_impl, device=dev,
             label=f"shard {s}/{len(self._devices)} on {dev}",
+            **self._tier_kwargs(s),
         )
+
+    def _tier_kwargs(self, s: int) -> dict:
+        """Per-shard tiered-residency kwargs (empty dict when untiered):
+        a disk cold tier gets a per-shard spill subdirectory so shards
+        never collide on generation/sequence file names."""
+        if self.hbm_budget_bytes is None:
+            return {}
+        cold_dir = self.cold_dir
+        if cold_dir is not None:
+            import os
+
+            cold_dir = os.path.join(cold_dir, f"shard-{s:02d}")
+        return {
+            "hbm_budget_bytes": self.hbm_budget_bytes,
+            "cold_tier": self.cold_tier, "cold_dir": cold_dir,
+        }
+
+    def close(self) -> None:
+        """Close every shard's tiered-residency worker (no-op when
+        untiered, idempotent)."""
+        for s in self._shards:
+            s.close()
 
     # -- shape/accounting ----------------------------------------------------
 

@@ -1,0 +1,183 @@
+"""``make tier-smoke``: tiered hot/cold residency parity (ISSUE 19).
+
+Asserts, at toy shapes on CPU, the acceptance contract of the r21
+tiered-residency layer: an index whose corpus is 4× an artificially
+capped HBM budget — one chunk hot, three cold — answers BIT-IDENTICALLY
+to a fully resident index on every serving path (exact top-k, LSH
+candidate tier at partial and full probe coverage, tombstones spanning
+the hot/cold seam, the 8-shard merge with per-shard budgets, and the
+disk rung's memmap-backed spills), the hot set never exceeds the
+budget, the degraded rung (an injected staging-upload failure) still
+returns exact answers while landing on the fallback counter, and a
+tiered snapshot round-trips through ``durable`` with its residency
+block verified.  Runs before tier-1 in ``make verify`` on the same
+virtual-8-device topology the shard smoke uses.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+__all__ = ["main"]
+
+
+def main() -> None:
+    import jax
+
+    from randomprojection_tpu import durable
+    from randomprojection_tpu.ann import (
+        LSHShardedSimHashIndex,
+        LSHSimHashIndex,
+    )
+    from randomprojection_tpu.models import sketch as sk
+    from randomprojection_tpu.models.sketch import SimHashIndex
+    from randomprojection_tpu.utils import telemetry
+
+    n_dev = len(jax.devices())
+    rng = np.random.default_rng(0)
+    chunk_rows, n_bytes, n_chunks = 600, 8, 4
+    codes = rng.integers(
+        0, 256, size=(chunk_rows * n_chunks, n_bytes), dtype=np.uint8
+    )
+    queries = rng.integers(0, 256, size=(24, n_bytes), dtype=np.uint8)
+    m = 7
+    # the acceptance shape: the corpus is 4× the HBM budget — exactly
+    # one of the four equal chunks fits hot, three live cold
+    budget = chunk_rows * n_bytes
+
+    def build(cls, **kw):
+        idx = cls(codes[:chunk_rows], **kw)
+        for lo in range(chunk_rows, codes.shape[0], chunk_rows):
+            idx.add(codes[lo : lo + chunk_rows])
+        return idx
+
+    # -- exact path: 4×-over-budget vs fully resident -----------------------
+    ref = build(SimHashIndex)
+    tiered = build(SimHashIndex, hbm_budget_bytes=budget)
+    r = tiered._tier.residency()
+    assert r["hot_bytes"] <= budget, "hot set exceeds the HBM budget"
+    assert any(c["tier"] != "hot" for c in r["chunks"]), (
+        "4x-over-budget index has no cold chunks — the cap is not binding"
+    )
+    rd, ri = ref.query_topk(queries, m)
+    td, ti = tiered.query_topk(queries, m)
+    assert np.array_equal(td, rd) and np.array_equal(ti, ri), (
+        "exact path: tiered != fully resident"
+    )
+
+    # -- LSH candidate tier: partial + full probes, tombstones --------------
+    full = 1 << 4
+    lref = build(LSHSimHashIndex, bands=4, band_bits=4,
+                 fallback_density=1.0, probe_path="host")
+    ltier = build(LSHSimHashIndex, bands=4, band_bits=4,
+                  fallback_density=1.0, probe_path="host",
+                  hbm_budget_bytes=budget)
+    for p in (2, full):
+        rd2, ri2 = lref.query_topk(queries, m, probes=p)
+        td2, ti2 = ltier.query_topk(queries, m, probes=p)
+        assert np.array_equal(td2, rd2) and np.array_equal(ti2, ri2), (
+            f"LSH path at probes={p}: tiered != fully resident"
+        )
+    # tombstones spanning the hot/cold chunk seam filter identically
+    dead = np.arange(chunk_rows - 60, chunk_rows + 60)
+    lref.delete(dead)
+    ltier.delete(dead)
+    rd3, ri3 = lref.query_topk(queries, m, probes=full)
+    td3, ti3 = ltier.query_topk(queries, m, probes=full)
+    assert np.array_equal(td3, rd3) and np.array_equal(ti3, ri3), (
+        "tombstoned LSH path: tiered != fully resident"
+    )
+    # full coverage is still brute force through the tiered merge
+    D = sk.pairwise_hamming(queries, codes).astype(np.int64)
+    D[:, dead] = n_bytes * 8 + 1
+    bd, bi = sk._host_topk_select(D, m)
+    assert np.array_equal(td3, bd) and np.array_equal(ti3, bi), (
+        "tiered full-probe LSH != masked brute force"
+    )
+
+    # -- degraded rung: injected upload failure, exact answers --------------
+    from randomprojection_tpu.ops import topk_kernels
+
+    reg = telemetry.registry()
+    before = reg.counter("index.tier.fallbacks")
+    orig = topk_kernels.stage_rows
+
+    def _boom(*a, **k):
+        raise RuntimeError("injected staging failure")
+
+    topk_kernels.stage_rows = _boom
+    try:
+        fd, fi = ltier.query_topk(queries, m, probes=full)
+    finally:
+        topk_kernels.stage_rows = orig
+    assert np.array_equal(fd, rd3) and np.array_equal(fi, ri3), (
+        "upload-failure rung returned wrong answers"
+    )
+    assert reg.counter("index.tier.fallbacks") > before, (
+        "upload-failure rung never hit the fallback counter"
+    )
+
+    # -- disk rung: memmap-backed spills, same parity -----------------------
+    with tempfile.TemporaryDirectory() as td_:
+        cold_dir = os.path.join(td_, "cold")
+        disk = build(SimHashIndex, hbm_budget_bytes=budget,
+                     cold_tier="disk", cold_dir=cold_dir)
+        spills = [f for f in os.listdir(cold_dir)
+                  if f.startswith("chunk-")]
+        assert len(spills) == n_chunks - 1, (
+            f"disk tier spilled {len(spills)} chunks, expected "
+            f"{n_chunks - 1}"
+        )
+        dd, di = disk.query_topk(queries, m)
+        assert np.array_equal(dd, rd) and np.array_equal(di, ri), (
+            "disk-tier exact path != fully resident"
+        )
+        # tiered snapshot round-trip: the residency block verifies and
+        # a budget-less restore loads everything hot with equal answers
+        snap = os.path.join(td_, "snap")
+        manifest = durable.save_index(disk, snap)
+        assert manifest["tier"]["cold_tier"] == "disk"
+        status = durable.verify_snapshot(snap)
+        assert status["ok"] and status["tier"]["cold_chunks"] > 0, (
+            f"tiered snapshot failed verification: {status}"
+        )
+        restored = durable.load_index(snap)
+        ld, li = restored.query_topk(queries, m)
+        assert np.array_equal(ld, rd) and np.array_equal(li, ri), (
+            "snapshot-restored index != fully resident"
+        )
+        disk.close()
+
+    # -- 8-shard merge with per-shard budgets (incl. tombstones) ------------
+    sref = LSHShardedSimHashIndex(codes, n_shards=8, bands=4, band_bits=4,
+                                  fallback_density=1.0, probe_path="host")
+    stier = LSHShardedSimHashIndex(
+        codes, n_shards=8, bands=4, band_bits=4, fallback_density=1.0,
+        probe_path="host", hbm_budget_bytes=n_bytes,
+    )  # per-shard budget below any chunk: every shard serves all-cold
+    for idx_ in (sref, stier):
+        idx_.delete(np.arange(200, 420))
+    sd, si = sref.query_topk(queries, m, probes=full)
+    td4, ti4 = stier.query_topk(queries, m, probes=full)
+    assert np.array_equal(td4, sd) and np.array_equal(ti4, si), (
+        "8-shard tiered merge != fully resident sharded"
+    )
+    stier.close()
+    for idx_ in (ref, tiered, lref, ltier):
+        idx_.close()
+
+    print(
+        f"tier-smoke OK: 4x-over-budget tiered index bit-identical to "
+        f"fully resident on {n_dev} device(s) — exact + LSH "
+        "(partial/full probes), seam-spanning tombstones, injected "
+        "upload-failure rung, disk-tier memmap spills, snapshot "
+        "round-trip with verified residency block, 8-shard all-cold "
+        "merge"
+    )
+
+
+if __name__ == "__main__":
+    main()

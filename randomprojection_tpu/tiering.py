@@ -1,0 +1,622 @@
+"""Tiered hot/cold chunk residency for the serving indexes (ISSUE 19 / r21).
+
+The serving story so far topped out at "corpus per chip = HBM per chip":
+every ``SimHashIndex`` chunk is device-resident.  The LSH candidate tier
+changed the economics — at recall-preserving probe counts a query tile
+touches a few percent of the corpus, so most chunks are cold most of the
+time.  This module multiplies corpus-per-chip by letting cold chunks
+leave HBM without leaving the index:
+
+- **hot** — a device-resident chunk, exactly the pre-r21 path.  Queries
+  gather/score it with zero new cost.
+- **cold (host)** — the chunk's packed codes live in host memory as a
+  plain ``np.ndarray``.  Candidate rows are gathered on host and
+  streamed H2D asynchronously (``ops.topk_kernels.stage_rows``) so the
+  upload overlaps the hot-tier kernel.
+- **cold (disk)** — the host array is demoted once more into an r11-
+  format spill file (``chunk-GGGGGG-SSSSSSSS.npy``, checksummed,
+  generation-numbered, written write-tmp → fsync → replace) and served
+  through a read-only ``np.load(mmap_mode='r')`` view: row gathers read
+  only the touched pages.
+
+Residency never changes ANSWERS — every path re-ranks with the same
+exact kernels under the same (distance, lower-global-id) order, and the
+hot/cold split re-merges through the union-of-top-m identity — it only
+changes where bytes live and when they move.  The fallback ladder rung:
+residency pressure or a failed staging upload degrades to a synchronous
+fetch (``index.tier.fallback``, on the doctor's degraded audit), never
+to wrong answers.
+
+Admission/eviction: chunks are admitted hot at append until the HBM
+budget is full; after that, per-chunk access counts folded from the
+serving gathers (the same signal the ``index.lsh.*`` bucket counters
+aggregate) drive a greedy re-plan (``plan_residency``), and promotions/
+demotions run as BOUNDED background work — one worker thread behind a
+bounded queue with sentinel shutdown and a joined ``close()``, the same
+RP04/RP08/RP10 discipline every other thread substrate in this repo
+follows.  A rebalance that loses the enqueue race is dropped, not
+queued unboundedly; the next access re-plans.
+
+Thread-safety: the manager's own state (residency table, scores, spill
+map) is lock-protected.  ``chunk.b`` swaps happen under that lock, but
+serving threads read ``chunk.b`` lock-free — a single attribute load —
+and EITHER binding is correct: a stale device array still holds the
+same rows, and a just-demoted numpy array round-trips through jax's
+implicit (synchronous) upload.  Races cost a slow tile, never a wrong
+one.  Telemetry is emitted OUTSIDE the lock (RP10).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+__all__ = ["COLD_TIERS", "ResidencyPlan", "plan_residency",
+           "TieredResidency"]
+
+COLD_TIERS = ("host", "disk")
+
+# bounded background work: at most this many promote/demote ops pending;
+# the queue is sized one larger so close()'s sentinel always has a slot
+_MAX_PENDING_OPS = 2
+
+
+class ResidencyPlan:
+    """Which chunks the budget keeps hot: ``hot`` is the set of chunk
+    ordinals, ``hot_bytes`` their payload total, ``staging_bytes`` the
+    transient headroom the serving paths may additionally occupy for
+    double-buffered cold staging (two in-flight row buckets — reported
+    so operators size budgets honestly, not charged against admission:
+    staged buffers are transient and bounded by construction)."""
+
+    __slots__ = ("hot", "hot_bytes", "budget_bytes", "staging_bytes")
+
+    def __init__(self, hot, hot_bytes: int, budget_bytes: int,
+                 staging_bytes: int):
+        self.hot = frozenset(hot)
+        self.hot_bytes = int(hot_bytes)
+        self.budget_bytes = int(budget_bytes)
+        self.staging_bytes = int(staging_bytes)
+
+
+def plan_residency(chunk_bytes, budget_bytes: int,
+                   scores=None) -> ResidencyPlan:
+    """The residency planner (the tier's budget function, registered in
+    rplint's ``KERNEL_BUDGET_FNS``): greedily admit chunks hot in
+    descending access-score order (ties to the LOWER ordinal — older
+    chunks, deterministic plans) until the next chunk would overflow
+    ``budget_bytes``.  ``scores=None`` plans by ordinal alone (the
+    append-order admission the constructor uses before any access
+    statistics exist).  The two double-buffered staging slots are
+    bounded by the largest cold chunk's single row bucket, reported as
+    ``staging_bytes``."""
+    sizes = [int(b) for b in chunk_bytes]
+    if budget_bytes < 0:
+        raise ValueError(f"budget_bytes must be >= 0, got {budget_bytes}")
+    n = len(sizes)
+    sc = [0.0] * n if scores is None else [float(s) for s in scores]
+    if len(sc) != n:
+        raise ValueError(
+            f"scores has {len(sc)} entries for {n} chunks"
+        )
+    order = sorted(range(n), key=lambda i: (-sc[i], i))
+    hot = set()
+    hot_bytes = 0
+    for i in order:
+        if hot_bytes + sizes[i] <= budget_bytes:
+            hot.add(i)
+            hot_bytes += sizes[i]
+    cold_max = max((sizes[i] for i in range(n) if i not in hot), default=0)
+    return ResidencyPlan(hot, hot_bytes, budget_bytes, 2 * cold_max)
+
+
+class _Entry:
+    """Per-chunk residency record: the chunk object, its payload bytes,
+    whether it is device-resident, its access score, and (disk tier)
+    its spill manifest entry."""
+
+    __slots__ = ("chunk", "nbytes", "hot", "score", "spill")
+
+    def __init__(self, chunk, nbytes: int, hot: bool):
+        self.chunk = chunk
+        self.nbytes = int(nbytes)
+        self.hot = bool(hot)
+        self.score = 0.0
+        self.spill: Optional[dict] = None
+
+
+class TieredResidency:
+    """Hot/cold residency manager for one index's chunk list (module
+    docstring has the full story).  Created by ``SimHashIndex`` when
+    ``hbm_budget_bytes`` is set; the index funnels every append through
+    ``admit``/``place_cold``/``register`` and every serving gather
+    through ``note_gather``/``note_fetch``, and calls ``close()`` when
+    it is done (joins the background worker)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, budget_bytes: int, *, cold_tier: str = "host",
+                 cold_dir: Optional[str] = None,
+                 device_put=None):
+        if budget_bytes < 0:
+            raise ValueError(
+                f"hbm_budget_bytes must be >= 0, got {budget_bytes}"
+            )
+        if cold_tier not in COLD_TIERS:
+            raise ValueError(
+                f"cold_tier must be one of {COLD_TIERS}, got {cold_tier!r}"
+            )
+        if cold_tier == "disk":
+            if not cold_dir:
+                raise ValueError(
+                    "cold_tier='disk' requires cold_dir= (the spill "
+                    "directory for demoted chunks)"
+                )
+            os.makedirs(cold_dir, exist_ok=True)
+        self.budget_bytes = int(budget_bytes)
+        self.cold_tier = cold_tier
+        self.cold_dir = cold_dir
+        # uploads route through the owning index's placement (pinned
+        # device or platform default); None = jnp.asarray
+        self._device_put = device_put
+        self._lock = threading.Lock()
+        self._entries: list = []       # _Entry per chunk, append order
+        self._by_row0: dict = {}       # chunk.row0 -> _Entry
+        self._hot_bytes = 0
+        self._gen = 1                  # spill generation (bumped on reset)
+        self._spill_seq = 0
+        import queue as _queue
+
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=_MAX_PENDING_OPS + 1)
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- append-time admission ----------------------------------------------
+
+    def admit(self, nbytes: int) -> bool:
+        """True when a new chunk of ``nbytes`` payload fits the budget
+        alongside the currently hot set (it then uploads exactly like
+        an untiered chunk); False routes it cold."""
+        with self._lock:
+            return self._hot_bytes + int(nbytes) <= self.budget_bytes
+
+    def place_cold(self, codes: np.ndarray) -> np.ndarray:
+        """Materialize a cold chunk's backing array: host tier keeps a
+        private host copy; disk tier writes the r11-format spill and
+        returns the read-only mmap view.  Returns the array to bind as
+        ``chunk.b`` (``register`` records the spill entry)."""
+        codes = np.ascontiguousarray(codes, dtype=np.uint8)
+        if self.cold_tier == "host":
+            return codes.copy()
+        arr, self._pending_spill = self._spill_to_disk(codes)
+        return arr
+
+    def register(self, chunk, nbytes: int, hot: bool) -> None:
+        """Record a freshly appended chunk's residency."""
+        e = _Entry(chunk, nbytes, hot)
+        if not hot and self.cold_tier == "disk":
+            e.spill = self.__dict__.pop("_pending_spill", None)
+        with self._lock:
+            self._entries.append(e)
+            self._by_row0[chunk.row0] = e
+            if hot:
+                self._hot_bytes += e.nbytes
+            frac = self._hot_fraction_locked()
+        telemetry.registry().gauge_set("index.tier.hot_fraction", frac)
+
+    # -- residency queries (serving path, lock-held briefly) -----------------
+
+    def chunk_is_hot(self, chunk) -> bool:
+        with self._lock:
+            e = self._by_row0.get(chunk.row0)
+            return e is None or e.hot
+
+    def any_cold(self) -> bool:
+        with self._lock:
+            return any(not e.hot for e in self._entries)
+
+    def residency(self) -> dict:
+        """Introspection snapshot: per-chunk tier tags plus byte
+        accounting (the manifest block and the smoke assertions read
+        this)."""
+        with self._lock:
+            chunks = [
+                {
+                    "row0": int(e.chunk.row0),
+                    "rows": int(e.chunk.n),
+                    "tier": "hot" if e.hot else self.cold_tier,
+                }
+                for e in self._entries
+            ]
+            hot_bytes = self._hot_bytes
+        return {
+            "cold_tier": self.cold_tier,
+            "hbm_budget_bytes": self.budget_bytes,
+            "hot_bytes": hot_bytes,
+            "chunks": chunks,
+        }
+
+    def manifest_block(self) -> dict:
+        """The ``tier`` manifest block ``durable.save_index`` persists:
+        format-versioned so a future layout change fails loudly in old
+        readers, carrying the budget, the cold tier tag and per-chunk
+        residency at snapshot time (restore re-tiers by its own budget;
+        the tags are provenance + verification surface)."""
+        r = self.residency()
+        return {"tier": {
+            "format": 1,
+            "cold_tier": r["cold_tier"],
+            "hbm_budget_bytes": r["hbm_budget_bytes"],
+            "chunks": r["chunks"],
+        }}
+
+    def _hot_fraction_locked(self) -> float:
+        total = sum(e.nbytes for e in self._entries)
+        return (self._hot_bytes / total) if total else 1.0
+
+    # -- access accounting + background rebalance ----------------------------
+
+    def note_gather(self, hot_rows: int, cold_rows: int,
+                    per_chunk_rows: dict) -> None:
+        """Fold one serving gather into the access statistics: row
+        counts per side (the hot-hit signal) and per touched chunk (the
+        admission/eviction signal), then re-plan.  ``per_chunk_rows``
+        maps ``chunk.row0`` → rows gathered from that chunk."""
+        with self._lock:
+            for row0, rows in per_chunk_rows.items():
+                e = self._by_row0.get(row0)
+                if e is not None:
+                    e.score += float(rows)
+        reg = telemetry.registry()
+        reg.counter_inc("index.tier.hot_rows", int(hot_rows))
+        reg.counter_inc("index.tier.cold_rows", int(cold_rows))
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.INDEX_TIER_HIT, hot_rows=int(hot_rows),
+                cold_rows=int(cold_rows),
+                **telemetry.trace_fields(),
+            )
+        self._maybe_rebalance()
+
+    def note_fetch(self, *, rows: int, nbytes: int, wall_s: float,
+                   overlap_s: float, source: str, sync: bool,
+                   promote: bool = False) -> None:
+        """Record one cold-tier fetch: the host-side gather+stage wall,
+        and the overlap window the upload had to hide under the
+        hot-tier kernel (0 on a synchronous rung)."""
+        reg = telemetry.registry()
+        reg.counter_inc("index.tier.fetches")
+        reg.observe("index.tier.fetch_s", float(wall_s))
+        if overlap_s > 0:
+            reg.observe("index.tier.overlap_s", float(overlap_s))
+        if telemetry.enabled():
+            telemetry.emit(
+                EVENTS.INDEX_TIER_FETCH, rows=int(rows),
+                bytes=int(nbytes), wall_s=round(float(wall_s), 6),
+                overlap_s=round(float(overlap_s), 6), source=source,
+                sync=bool(sync), promote=bool(promote),
+                **telemetry.trace_fields(),
+            )
+
+    def note_fallback(self, reason: str, *, rows: int = 0) -> None:
+        """The degraded rung: residency pressure or a failed staging
+        upload served synchronously — on the doctor's degraded audit,
+        like every other ladder rung in this repo."""
+        telemetry.registry().counter_inc("index.tier.fallbacks")
+        telemetry.emit(
+            EVENTS.INDEX_TIER_FALLBACK, reason=reason, rows=int(rows),
+            **telemetry.trace_fields(),
+        )
+
+    def demote(self, row0: int) -> bool:
+        """Synchronously demote one chunk by its first global row id —
+        the maintenance/fault-harness surface (the serving path demotes
+        in the background instead).  Returns True when the chunk was
+        hot and is now cold."""
+        with self._lock:
+            e = self._by_row0.get(row0)
+        if e is None or not e.hot:
+            return False
+        self._demote(e)
+        return not e.hot
+
+    def _maybe_rebalance(self) -> None:
+        """Re-plan residency from the current scores and enqueue the
+        diff as bounded background work.  Planning is O(chunks·log) on
+        the serving thread; the byte movement happens on the worker.
+        A full queue drops the rebalance (the next access re-plans) —
+        background work stays bounded, never a backlog."""
+        with self._lock:
+            if self._closed.is_set():
+                return
+            sizes = [e.nbytes for e in self._entries]
+            scores = [
+                # current residency wins exact ties: no ping-pong churn
+                # between equal-score chunks
+                e.score + (0.5 if e.hot else 0.0)
+                for e in self._entries
+            ]
+            plan = plan_residency(sizes, self.budget_bytes, scores)
+            ops = [
+                ("promote" if i in plan.hot else "demote", e)
+                for i, e in enumerate(self._entries)
+                if (i in plan.hot) != e.hot
+            ]
+            if not ops:
+                return
+            start_worker = self._thread is None
+            if start_worker:
+                from threading import Thread
+
+                self._thread = Thread(
+                    target=self._run, name="rp-tier-worker", daemon=True
+                )
+        # enqueue OUTSIDE the lock (RP11: a queue put never runs under
+        # a held lock); put_nowait + qsize bound keeps the sentinel slot
+        # free and the backlog at _MAX_PENDING_OPS
+        for op in ops:
+            if self._q.qsize() >= _MAX_PENDING_OPS:
+                telemetry.registry().counter_inc("index.tier.rebalance_drops")
+                break
+            self._q.put_nowait(op)
+        if start_worker:
+            self._thread.start()
+
+    # -- the background worker ----------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                return
+            op, entry = item
+            try:
+                if op == "promote":
+                    # the promotion d2h/H2D runs on THIS dedicated
+                    # background worker, off every serving thread:
+                    # blocking here is the design
+                    self._promote(entry)  # rplint: allow[RP09] — background worker owns the blocking byte movement
+                else:
+                    # same: the demotion's host copy is the background
+                    # work itself, not a hidden sync on a serving loop
+                    self._demote(entry)  # rplint: allow[RP09] — background worker owns the blocking byte movement
+            except Exception as e:
+                # a failed byte movement degrades residency, never the
+                # index: the chunk simply stays where it was (every
+                # serving path handles either residency), recorded on
+                # the degraded audit
+                self.note_fallback(f"{op}_failed:{type(e).__name__}")
+
+    def _promote(self, entry: _Entry) -> None:
+        chunk = entry.chunk
+        with self._lock:
+            if entry.hot or self._closed.is_set():
+                return
+            if self._hot_bytes + entry.nbytes > self.budget_bytes:
+                return  # plan went stale; the next access re-plans
+            b = chunk.b
+        t0 = time.perf_counter()
+        host = np.ascontiguousarray(np.asarray(b))
+        dev = (self._device_put(host) if self._device_put is not None
+               else self._jnp_asarray(host))
+        spill = None
+        with self._lock:
+            if entry.hot:
+                return
+            chunk.b = dev
+            entry.hot = True
+            self._hot_bytes += entry.nbytes
+            spill, entry.spill = entry.spill, None
+            frac = self._hot_fraction_locked()
+        if spill is not None and self.cold_dir:
+            try:
+                os.unlink(os.path.join(self.cold_dir, spill["file"]))
+            except OSError:
+                pass  # a leftover spill is debris, not corruption
+        reg = telemetry.registry()
+        reg.counter_inc("index.tier.promotions")
+        reg.gauge_set("index.tier.hot_fraction", frac)
+        self.note_fetch(
+            rows=int(chunk.n), nbytes=entry.nbytes,
+            wall_s=time.perf_counter() - t0, overlap_s=0.0,
+            source=self.cold_tier, sync=False, promote=True,
+        )
+
+    def _demote(self, entry: _Entry) -> None:
+        from randomprojection_tpu import durable
+        from randomprojection_tpu.models.sketch import _start_host_copy
+
+        chunk = entry.chunk
+        with self._lock:
+            if not entry.hot or self._closed.is_set():
+                return
+            b = chunk.b
+        t0 = time.perf_counter()
+        _start_host_copy(b)
+        host = np.ascontiguousarray(np.asarray(b)[: chunk.n])
+        if self.cold_tier == "disk":
+            arr, spill = self._spill_to_disk(host)
+            # fault-injection point: the spill file exists but the
+            # residency swap (and any manifest that would reference the
+            # demotion) has not happened — a SIGKILL here must leave a
+            # loadable snapshot with the file as sweepable debris
+            durable._maybe_kill("mid-demotion")
+        else:
+            arr, spill = host, None
+        with self._lock:
+            if not entry.hot:
+                return
+            chunk.b = arr
+            chunk.dead_dev = None   # device-resident mask goes with b
+            chunk.dead_rev = -1
+            entry.hot = False
+            entry.spill = spill
+            self._hot_bytes -= entry.nbytes
+            frac = self._hot_fraction_locked()
+        reg = telemetry.registry()
+        reg.counter_inc("index.tier.evictions")
+        reg.gauge_set("index.tier.hot_fraction", frac)
+        telemetry.emit(
+            EVENTS.INDEX_TIER_EVICT, rows=int(chunk.n),
+            bytes=entry.nbytes, tier=self.cold_tier,
+            wall_s=round(time.perf_counter() - t0, 6),
+            **telemetry.trace_fields(),
+        )
+
+    def _spill_to_disk(self, codes: np.ndarray):
+        """Write one cold chunk in the r11 spill format (atomic,
+        checksummed, generation-numbered) and return ``(mmap_view,
+        manifest_entry)``.  The write-back is verified by re-reading
+        and re-hashing — a demotion must never trade a good device copy
+        for a corrupt disk one."""
+        from randomprojection_tpu import durable
+
+        with self._lock:
+            gen, seq = self._gen, self._spill_seq
+            self._spill_seq += 1
+        fname = f"chunk-{gen:06d}-{seq:08d}.npy"
+        path = os.path.join(self.cold_dir, fname)
+        sha = durable._sha256(codes)
+        durable._write_npy_atomic(path, codes)
+        arr = np.load(path, mmap_mode="r")
+        if durable._sha256(np.asarray(arr)) != sha:
+            raise ValueError(
+                f"cold-tier spill {path} failed read-back verification"
+            )
+        entry = {"file": fname, "rows": int(codes.shape[0]), "sha256": sha}
+        return arr, entry
+
+    def _jnp_asarray(self, host):
+        import jax.numpy as jnp
+
+        return jnp.asarray(host)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the background worker (idempotent): mark closed, send
+        the sentinel, join.  In-flight promotions/demotions finish;
+        queued ones re-check the closed flag and no-op."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        # the sentinel's slot is reserved by construction (queue holds
+        # _MAX_PENDING_OPS + 1; producers stop at _MAX_PENDING_OPS) and
+        # close() runs after _closed is set, so no producer races it in;
+        # enqueued unconditionally — a worker started between the flag
+        # and the join still drains to the sentinel and exits
+        self._q.put(self._SENTINEL)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():  # pragma: no cover — wedged put
+                telemetry.registry().counter_inc("index.tier.close_timeouts")
+
+    def reset(self) -> None:
+        """Forget every chunk (compaction/rebuild path — the caller
+        guarantees quiescence, as ``compact`` already documents) and
+        unlink this manager's spill files; the rebuild re-registers the
+        new chunks under a fresh spill generation."""
+        with self._lock:
+            spills = [e.spill for e in self._entries if e.spill]
+            self._entries = []
+            self._by_row0 = {}
+            self._hot_bytes = 0
+            self._gen += 1
+            self._spill_seq = 0
+        for spill in spills:
+            try:
+                os.unlink(os.path.join(self.cold_dir, spill["file"]))
+            except OSError:
+                pass  # debris, swept by the durable orphan scan
+
+
+class _TileStager:
+    """Double-buffered cold-chunk staging for the EXACT serving path
+    (``SimHashIndex._topk_dispatch_tile``): ``resolve(i)`` returns the
+    device array to score chunk ``i`` with (``None`` = the chunk is hot,
+    use its resident handle) and starts the NEXT cold chunk's
+    asynchronous upload before returning, so that transfer streams
+    under chunk ``i``'s kernel — the in-kernel DMA double-buffering
+    idiom applied at the tier boundary.  At most two staged buffers
+    exist at once (one being consumed, one in flight): bounded
+    transient HBM, sized by ``ResidencyPlan.staging_bytes``.  A failed
+    upload degrades to dispatching the chunk's host array directly (jax
+    commits it synchronously) on the degraded audit — never a wrong
+    answer.  One stager serves one dispatched tile on one thread; the
+    residency manager outlives it."""
+
+    def __init__(self, chunks, tier: TieredResidency, device_put):
+        self._chunks = chunks
+        self._tier = tier
+        self._put = device_put
+        self._cold = [
+            i for i, c in enumerate(chunks) if not tier.chunk_is_hot(c)
+        ]
+        self._staged: dict = {}  # ordinal -> (array, wall_s, t_started)
+        self._hot_rows = 0
+        self._cold_rows = 0
+        self._per_chunk: dict = {}
+
+    def _stage(self, i: int) -> None:
+        if i in self._staged or len(self._staged) >= 2:
+            return
+        c = self._chunks[i]
+        t0 = time.perf_counter()
+        # np.asarray is the actual cold fetch: a host copy reads RAM, a
+        # disk-tier memmap reads only this chunk's pages
+        host = np.ascontiguousarray(np.asarray(c.b))
+        try:
+            dev = self._put(host)
+        except Exception as e:
+            self._tier.note_fallback(
+                f"upload:{type(e).__name__}", rows=int(c.n)
+            )
+            dev = host  # degraded rung: sync upload at dispatch
+        self._staged[i] = (dev, time.perf_counter() - t0,
+                           time.perf_counter())
+
+    def resolve(self, i: int):
+        c = self._chunks[i]
+        if self._tier.chunk_is_hot(c):
+            self._hot_rows += int(c.n)
+            b = None
+        else:
+            ent = self._staged.pop(i, None)
+            prestaged = ent is not None
+            if not prestaged:
+                self._stage(i)
+                ent = self._staged.pop(i)
+            b, wall_s, t_started = ent
+            overlap = (time.perf_counter() - t_started) if prestaged else 0.0
+            self._cold_rows += int(c.n)
+            self._tier.note_fetch(
+                rows=int(c.n), nbytes=int(c.n) * int(c.b.shape[1]),
+                wall_s=wall_s, overlap_s=overlap,
+                source=self._tier.cold_tier, sync=not prestaged,
+            )
+        self._per_chunk[c.row0] = self._per_chunk.get(c.row0, 0) + int(c.n)
+        # start the next cold chunk's upload BEFORE this chunk's kernel
+        # dispatches — that H2D rides under the kernel's compute
+        for j in self._cold:
+            if j > i and j not in self._staged:
+                # _stage's asarray is the host-side read of an
+                # already-host (or memmap) chunk feeding an ASYNC
+                # device_put: this call site IS the overlapped
+                # prefetch the rule asks for, one chunk ahead
+                self._stage(j)  # rplint: allow[RP09] — this call IS the one-ahead overlapped prefetch
+                break
+        return b
+
+    def finish(self, queries: int) -> None:
+        """Fold this tile's access pattern into the residency manager
+        (the admission/eviction signal) once the dispatch loop is done."""
+        self._tier.note_gather(
+            self._hot_rows, self._cold_rows, self._per_chunk
+        )

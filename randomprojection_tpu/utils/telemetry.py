@@ -253,6 +253,16 @@ class EVENTS:
     HEALTH_QUEUE_PINNED = "health.queue_pinned"
     HEALTH_DEGRADED_SPIKE = "health.degraded_spike"
     HEALTH_FLIGHT_DUMP = "health.flight_dump"
+    # tiered hot/cold residency (ISSUE 19 / r21): per-gather hot-tier
+    # hit record, cold-tier row fetch (rows/bytes/wall, with the
+    # overlapped-under-the-hot-kernel window), demotion/promotion churn,
+    # and the synchronous-fetch fallback rung (degraded — on the
+    # doctor's audit).  Deliberately NOT a family — rogue
+    # ``index.tier.*`` names stay lintable (rp02_tier_bad.py).
+    INDEX_TIER_HIT = "index.tier.hit"
+    INDEX_TIER_FETCH = "index.tier.fetch"
+    INDEX_TIER_EVICT = "index.tier.evict"
+    INDEX_TIER_FALLBACK = "index.tier.fallback"
 
     # runtime-completed name families.  ``*_FAMILY`` constants are the
     # prefixes callers build on (today: the per-kernel-path hash counter

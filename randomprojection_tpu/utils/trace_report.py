@@ -91,6 +91,11 @@ DEGRADED_EVENTS = (
     # dense/starved served through the exact scan instead — correct but
     # sublinear no more, so the fallback rate belongs on the audit
     EVENTS.INDEX_LSH_FALLBACK,
+    # tiered residency (r21): a cold chunk served through the
+    # synchronous-fetch rung (upload failure, budget race, worker
+    # error) stayed bit-identical but lost the overlap — the rate
+    # belongs on the audit
+    EVENTS.INDEX_TIER_FALLBACK,
 )
 
 
@@ -217,6 +222,23 @@ def build_report(path: str) -> dict:
     lsh_adaptive_probes_sum = 0.0
     lsh_adaptive_early = 0
     lsh_adaptive_budget = 0
+    # tiered residency (r21): per-tile hot/cold row split, the cold
+    # fetch ledger (wall, overlapped share, per-fetch walls for p99),
+    # promotion/demotion churn, and the degraded sync-fallback reasons
+    tier_tiles = 0
+    tier_hot_rows = 0
+    tier_cold_rows = 0
+    tier_fetches = 0
+    tier_fetch_rows = 0
+    tier_fetch_bytes = 0
+    tier_fetch_wall = 0.0
+    tier_overlap_wall = 0.0
+    tier_sync_fetches = 0
+    tier_fetch_walls: list = []
+    tier_promotions = 0
+    tier_evictions = 0
+    tier_evict_wall = 0.0
+    tier_fallbacks: dict = {}
 
     def _lat_observe(key: str, seconds: float) -> None:
         h = lat_hists.setdefault(key, {"sum": 0.0, "count": 0,
@@ -393,6 +415,33 @@ def build_report(path: str) -> dict:
         elif name == EVENTS.INDEX_LSH_BUILD:
             lsh_builds += 1
             lsh_build_rows += e.get("rows", 0) or 0
+        elif name == EVENTS.INDEX_TIER_HIT:
+            # one tile served by a tiered index: how many candidate rows
+            # sat in HBM vs the cold tier — the doctor's hot-hit ratio
+            tier_tiles += 1
+            tier_hot_rows += e.get("hot_rows", 0) or 0
+            tier_cold_rows += e.get("cold_rows", 0) or 0
+        elif name == EVENTS.INDEX_TIER_FETCH:
+            # one cold H2D upload; promote=True means the background
+            # worker re-admitted a chunk (churn), not a serving fetch
+            if e.get("promote"):
+                tier_promotions += 1
+            else:
+                tier_fetches += 1
+                tier_fetch_rows += e.get("rows", 0) or 0
+                tier_fetch_bytes += e.get("bytes", 0) or 0
+                w = e.get("wall_s", 0.0) or 0.0
+                tier_fetch_wall += w
+                tier_fetch_walls.append(w)
+                tier_overlap_wall += e.get("overlap_s", 0.0) or 0.0
+                if e.get("sync"):
+                    tier_sync_fetches += 1
+        elif name == EVENTS.INDEX_TIER_EVICT:
+            tier_evictions += 1
+            tier_evict_wall += e.get("wall_s", 0.0) or 0.0
+        elif name == EVENTS.INDEX_TIER_FALLBACK:
+            reason = str(e.get("reason") or "unknown")
+            tier_fallbacks[reason] = tier_fallbacks.get(reason, 0) + 1
         elif name in HEALTH_VERDICT_EVENTS:
             status = str(e.get("status") or "firing")
             d = health_counts.setdefault(name, {"firing": 0, "cleared": 0})
@@ -583,6 +632,48 @@ def build_report(path: str) -> dict:
             if (lsh_tiles or lsh_fallbacks or lsh_builds)
             else None
         ),
+        "residency": (
+            {
+                "tiles": tier_tiles,
+                "hot_rows": tier_hot_rows,
+                "cold_rows": tier_cold_rows,
+                "hot_hit_ratio": (
+                    round(
+                        tier_hot_rows / (tier_hot_rows + tier_cold_rows), 4
+                    )
+                    if (tier_hot_rows + tier_cold_rows)
+                    else None
+                ),
+                "cold_fetches": tier_fetches,
+                "cold_fetch_rows": tier_fetch_rows,
+                "cold_fetch_bytes": tier_fetch_bytes,
+                "cold_fetch_wall_s": round(tier_fetch_wall, 6),
+                # the share of fetch wall that rode UNDER the hot-tier
+                # kernel (the overlap the tier exists to buy)
+                "cold_fetch_overlapped_s": round(tier_overlap_wall, 6),
+                # nearest-rank p99: index ceil(0.99 n) - 1, exact over
+                # the full per-fetch wall list (doctor runs offline, so
+                # no bucket estimate needed here)
+                "cold_fetch_p99_s": (
+                    round(
+                        sorted(tier_fetch_walls)[
+                            (99 * len(tier_fetch_walls) + 99) // 100 - 1
+                        ],
+                        6,
+                    )
+                    if tier_fetch_walls
+                    else None
+                ),
+                "sync_fetches": tier_sync_fetches,
+                "promotions": tier_promotions,
+                "demotions": tier_evictions,
+                "demotion_wall_s": round(tier_evict_wall, 6),
+                "fallbacks": dict(sorted(tier_fallbacks.items())),
+            }
+            if (tier_tiles or tier_fetches or tier_evictions
+                or tier_promotions or tier_fallbacks)
+            else None
+        ),
         "latency": (
             {
                 key: quantiles_from_buckets(
@@ -765,6 +856,46 @@ def render_report(report: dict) -> str:
                 f"  bucket builds: {cg['builds']} fold(s), "
                 f"{cg['build_rows']} rows"
             )
+    rs = report.get("residency")
+    if rs:
+        lines.append("")
+        lines.append("residency (tiered hot/cold corpus, r21):")
+        ratio = rs.get("hot_hit_ratio")
+        lines.append(
+            f"  {rs['tiles']} tiered tile(s): {rs['hot_rows']} hot row(s) "
+            f"/ {rs['cold_rows']} cold row(s)"
+            + (f" — hot-hit ratio {ratio:.4f}" if ratio is not None else "")
+        )
+        if rs.get("cold_fetches"):
+            p99 = rs.get("cold_fetch_p99_s")
+            lines.append(
+                f"  cold fetches: {rs['cold_fetches']} "
+                f"({rs['cold_fetch_rows']} rows, "
+                f"{rs['cold_fetch_bytes']} bytes) — wall "
+                f"{rs['cold_fetch_wall_s']:.4f}s, overlapped "
+                f"{rs['cold_fetch_overlapped_s']:.4f}s under the hot "
+                f"kernel"
+                + (f", p99 {p99 * 1e3:.2f}ms" if p99 is not None else "")
+                + (
+                    f", {rs['sync_fetches']} synchronous"
+                    if rs.get("sync_fetches") else ""
+                )
+            )
+        if rs.get("promotions") or rs.get("demotions"):
+            lines.append(
+                f"  churn: {rs['promotions']} promotion(s), "
+                f"{rs['demotions']} demotion(s) "
+                f"({rs['demotion_wall_s']:.4f}s demotion wall, all "
+                "background)"
+            )
+        fb = rs.get("fallbacks") or {}
+        if fb:
+            detail = ", ".join(f"{k} {v}" for k, v in fb.items())
+            lines.append(
+                f"  degraded sync fallbacks: {sum(fb.values())} ({detail})"
+            )
+        else:
+            lines.append("  degraded sync fallbacks: none")
     lat = report.get("latency")
     if lat:
         lines.append("")

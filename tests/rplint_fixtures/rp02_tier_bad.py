@@ -1,0 +1,21 @@
+"""RP02 fixture (ISSUE 19 / r21 satellite): a tiered-residency path
+emitting an ``index.tier.*`` event name that is NOT in
+``telemetry.EVENTS``.  Linted against the REAL registry — the
+``index.tier`` namespace deliberately has NO family prefix, so every
+residency event must be individually registered (a family would wave
+rogue names through, and the doctor's residency section would silently
+miss them)."""
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.telemetry import EVENTS
+
+
+def fetch_with_unregistered_event(rows, nbytes):
+    # VIOLATION: a residency event dodging the registry — invisible to
+    # the doctor's residency section and the degraded audit
+    telemetry.emit("index.tier.rogue_prefetch", rows=rows, bytes=nbytes)
+    # ok: the registered cold-fetch record
+    telemetry.emit(
+        EVENTS.INDEX_TIER_FETCH, rows=rows, bytes=nbytes,
+        wall_s=0.0, overlap_s=0.0, source="host", sync=False,
+        promote=False,
+    )

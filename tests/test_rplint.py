@@ -508,6 +508,42 @@ def test_rp02_unregistered_lsh_event_fixture():
     assert not suppressed
 
 
+def test_rp02_unregistered_tier_event_fixture():
+    """ISSUE 19 / r21 satellite: an unregistered ``index.tier.*`` emit
+    is caught against the REAL shipped registry — the residency
+    namespace has no family prefix, so each event must be individually
+    registered, and the registered fetch event in the same fixture
+    stays clean."""
+    real = rplint.load_event_registry(
+        open(os.path.join(
+            rplint.package_root(), "utils", "telemetry.py"
+        )).read()
+    )
+    assert real is not None and real.knows("index.tier.hit")
+    assert real.knows("index.tier.fetch")
+    assert real.knows("index.tier.evict")
+    assert real.knows("index.tier.fallback")
+    assert not real.knows("index.tier.rogue_prefetch")
+    active, suppressed = _split(
+        _lint_fixture("rp02_tier_bad.py", registry=real)
+    )
+    assert [f.rule for f in active] == ["RP02"]
+    assert "'index.tier.rogue_prefetch'" in active[0].message
+    assert not suppressed
+
+
+def test_rplint_scope_includes_tiering_module():
+    """ISSUE 19 / r21 satellite: the residency manager is a
+    hot/pipeline/concurrency module (its stager loop re-serializes the
+    overlap if it blocks; its worker thread + manager lock are shared
+    with every serving thread) and its admission planner carries a
+    kernel-budget contract."""
+    assert "tiering.py" in rplint.HOT_MODULES
+    assert "tiering.py" in rplint.PIPELINE_MODULES
+    assert "tiering.py" in rplint.CONCURRENCY_MODULES
+    assert rplint.KERNEL_BUDGET_FNS.get("tiering.py") == "plan_residency"
+
+
 # -- ISSUE 11: flow-sensitive rules (RP07-RP09) ------------------------------
 
 

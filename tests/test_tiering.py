@@ -1,0 +1,451 @@
+"""Tiered hot/cold residency (ISSUE 19 / r21): the admission planner,
+the ``TieredResidency`` manager surface (admit/register/residency/
+manifest block), bit-parity of tiered serving with the fully resident
+index on the exact and LSH paths, the synchronous-fallback rung when
+the async upload dies, the disk spill + snapshot round trip, the
+manifest tier-block validator, and the doctor's residency section fed
+by real ``index.tier.*`` events.
+
+Shape discipline: same family as test_ann (8-byte codes, m=5, 8-row
+query tiles, 400-row corpora split into 4 chunks of 100) so compiled
+interpreter programs are shared, not re-paid per test."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from randomprojection_tpu import durable
+from randomprojection_tpu.models import sketch as sk
+from randomprojection_tpu.tiering import (
+    COLD_TIERS,
+    TieredResidency,
+    plan_residency,
+)
+from randomprojection_tpu.utils import telemetry
+
+N, NB, M, CHUNK = 400, 8, 5, 100
+# one chunk hot (100 rows x 8 B), three cold: 4x over budget
+BUDGET = CHUNK * NB
+
+
+def _codes(seed=0, n=N):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(n, NB), dtype=np.uint8
+    )
+
+
+def _queries(seed=100):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(8, NB), dtype=np.uint8
+    )
+
+
+def _ingest(index, codes):
+    for lo in range(0, codes.shape[0], CHUNK):
+        index.add(codes[lo : lo + CHUNK])
+    return index
+
+
+def _tiered(codes, **kw):
+    kw.setdefault("hbm_budget_bytes", BUDGET)
+    return _ingest(sk.SimHashIndex(codes[:0], **kw), codes)
+
+
+# -- the admission planner ---------------------------------------------------
+
+
+def test_plan_residency_greedy_by_score_then_ordinal():
+    p = plan_residency([10, 10, 10], 20)
+    assert p.hot == {0, 1} and p.hot_bytes == 20
+    # double-buffered staging headroom = 2 x largest cold chunk
+    assert p.staging_bytes == 20
+    p = plan_residency([10, 10, 10], 20, scores=[1.0, 5.0, 3.0])
+    assert p.hot == {1, 2}
+    # greedy, not knapsack: the best-scored chunk that fits is taken
+    # even when skipping it would pack more bytes
+    p = plan_residency([30, 10, 10], 20, scores=[9.0, 1.0, 1.0])
+    assert p.hot == {1, 2}
+
+
+def test_plan_residency_everything_fits_or_nothing():
+    p = plan_residency([10, 10], 100)
+    assert p.hot == {0, 1} and p.staging_bytes == 0
+    p = plan_residency([10, 10], 0)
+    assert p.hot == frozenset() and p.hot_bytes == 0
+
+
+def test_plan_residency_validation():
+    with pytest.raises(ValueError):
+        plan_residency([10], -1)
+    with pytest.raises(ValueError):
+        plan_residency([10, 10], 10, scores=[1.0])
+
+
+# -- manager surface ---------------------------------------------------------
+
+
+def test_tier_ctor_validation(tmp_path):
+    with pytest.raises(ValueError):
+        TieredResidency(-1)
+    with pytest.raises(ValueError):
+        TieredResidency(1024, cold_tier="lukewarm")
+    with pytest.raises(ValueError):
+        TieredResidency(1024, cold_tier="disk")  # no cold_dir
+    t = TieredResidency(1024, cold_tier="disk", cold_dir=str(tmp_path / "c"))
+    assert os.path.isdir(tmp_path / "c")
+    t.close()
+
+
+def test_index_ctor_tier_validation():
+    codes = _codes()
+    with pytest.raises(ValueError):
+        sk.SimHashIndex(codes, hbm_budget_bytes=1024, cold_tier="bogus")
+    with pytest.raises(ValueError):
+        sk.SimHashIndex(codes, hbm_budget_bytes=1024, cold_tier="disk")
+
+
+def test_residency_snapshot_and_manifest_block():
+    idx = _tiered(_codes())
+    try:
+        r = idx._tier.residency()
+        assert r["hbm_budget_bytes"] == BUDGET
+        assert r["hot_bytes"] <= BUDGET
+        assert [c["rows"] for c in r["chunks"]] == [CHUNK] * 4
+        tags = {c["tier"] for c in r["chunks"]}
+        assert tags <= {"hot", "host"} and "host" in tags
+        block = idx._tier.manifest_block()["tier"]
+        assert block["format"] == 1 and block["cold_tier"] == "host"
+        assert block["chunks"] == r["chunks"]
+    finally:
+        idx.close()
+
+
+def test_untiered_index_has_no_tier():
+    idx = sk.SimHashIndex(_codes())
+    assert idx._tier is None
+    idx.close()  # close() is safe untiered
+
+
+# -- bit-parity with the resident index --------------------------------------
+
+
+def test_exact_parity_4x_over_budget():
+    codes, q = _codes(), _queries()
+    resident = _ingest(sk.SimHashIndex(codes[:0]), codes)
+    tiered = _tiered(codes)
+    try:
+        rd, ri = resident.query_topk(q, M)
+        td, ti = tiered.query_topk(q, M)
+        assert (td == rd).all() and (ti == ri).all()
+        # the cold path actually ran: fetch traffic on the registry
+        assert telemetry.registry().counter("index.tier.cold_rows") > 0
+    finally:
+        tiered.close()
+        resident.close()
+
+
+def test_exact_parity_with_seam_spanning_tombstones():
+    codes, q = _codes(), _queries()
+    dead = np.arange(CHUNK - 20, CHUNK + 20)  # spans the chunk seam
+    resident = _ingest(sk.SimHashIndex(codes[:0]), codes)
+    tiered = _tiered(codes)
+    try:
+        resident.delete(dead)
+        tiered.delete(dead)
+        rd, ri = resident.query_topk(q, M)
+        td, ti = tiered.query_topk(q, M)
+        assert (td == rd).all() and (ti == ri).all()
+        assert not np.isin(ti, dead).any()
+    finally:
+        tiered.close()
+        resident.close()
+
+
+def test_sync_demote_keeps_parity():
+    codes, q = _codes(), _queries()
+    resident = _ingest(sk.SimHashIndex(codes[:0]), codes)
+    # budget fits everything; then demote one chunk by hand
+    tiered = _tiered(codes, hbm_budget_bytes=1 << 20)
+    try:
+        assert tiered._tier.demote(0) is True
+        assert tiered._tier.demote(0) is False  # already cold
+        assert tiered._tier.demote(99999) is False  # unknown row0
+        tags = [c["tier"] for c in tiered._tier.residency()["chunks"]]
+        assert tags[0] == "host" and set(tags[1:]) == {"hot"}
+        rd, ri = resident.query_topk(q, M)
+        td, ti = tiered.query_topk(q, M)
+        assert (td == rd).all() and (ti == ri).all()
+    finally:
+        tiered.close()
+        resident.close()
+
+
+def test_upload_failure_degrades_to_sync_fetch(monkeypatch):
+    # the LSH re-rank path stages cold candidate rows through
+    # topk_kernels.stage_rows; killing it must degrade to the
+    # synchronous host rung with identical answers + an audit record
+    codes, q = _codes(), _queries()
+    from randomprojection_tpu.ann import LSHSimHashIndex
+    from randomprojection_tpu.ops import topk_kernels
+
+    kw = dict(bands=4, band_bits=8, fallback_density=1.0,
+              probe_path="host")
+    resident = _ingest(LSHSimHashIndex(codes[:0], **kw), codes)
+    tiered = _ingest(
+        LSHSimHashIndex(codes[:0], hbm_budget_bytes=BUDGET, **kw), codes
+    )
+
+    def _boom(rows, **kw):
+        raise RuntimeError("injected upload failure")
+
+    try:
+        rd, ri = resident.query_topk(q, M, probes=2)
+        reg = telemetry.registry()
+        fb0 = reg.counter("index.tier.fallbacks")
+        monkeypatch.setattr(topk_kernels, "stage_rows", _boom)
+        td, ti = tiered.query_topk(q, M, probes=2)
+        assert (td == rd).all() and (ti == ri).all()
+        # host zero-padded gather is the synchronous rung: answers
+        # identical, the degraded audit records the dead upload
+        assert reg.counter("index.tier.fallbacks") > fb0
+    finally:
+        tiered.close()
+        resident.close()
+
+
+@pytest.mark.slow
+def test_lsh_parity_tiered_partial_and_full_probes():
+    codes, q = _codes(), _queries()
+    from randomprojection_tpu.ann import LSHSimHashIndex
+
+    kw = dict(bands=4, band_bits=8, fallback_density=1.0,
+              probe_path="host")
+    resident = _ingest(LSHSimHashIndex(codes[:0], **kw), codes)
+    tiered = _ingest(
+        LSHSimHashIndex(codes[:0], hbm_budget_bytes=BUDGET, **kw), codes
+    )
+    try:
+        for probes in (2, 1 << 8):  # partial + full coverage
+            rd, ri = resident.query_topk(q, M, probes=probes)
+            td, ti = tiered.query_topk(q, M, probes=probes)
+            assert (td == rd).all(), probes
+            assert (ti == ri).all(), probes
+    finally:
+        tiered.close()
+        resident.close()
+
+
+@pytest.mark.slow
+def test_lsh_sharded_tiered_parity():
+    codes, q = _codes(), _queries()
+    from randomprojection_tpu.ann import LSHShardedSimHashIndex
+
+    kw = dict(bands=4, band_bits=8, fallback_density=1.0,
+              probe_path="host", n_shards=4)
+    resident = LSHShardedSimHashIndex(codes, **kw)
+    tiered = LSHShardedSimHashIndex(
+        codes, hbm_budget_bytes=NB * CHUNK // 2, **kw
+    )
+    try:
+        rd, ri = resident.query_topk(q, M, probes=1 << 8)
+        td, ti = tiered.query_topk(q, M, probes=1 << 8)
+        assert (td == rd).all() and (ti == ri).all()
+    finally:
+        tiered.close()
+
+
+# -- disk tier + durability --------------------------------------------------
+
+
+def test_disk_tier_spills_and_snapshot_roundtrip(tmp_path):
+    codes, q = _codes(), _queries()
+    resident = _ingest(sk.SimHashIndex(codes[:0]), codes)
+    tiered = _tiered(
+        codes, cold_tier="disk", cold_dir=str(tmp_path / "cold")
+    )
+    snap = str(tmp_path / "snap")
+    try:
+        spills = sorted(os.listdir(tmp_path / "cold"))
+        assert len(spills) == 3  # 4 chunks, 1 hot
+        assert all(s.startswith("chunk-") and s.endswith(".npy")
+                   for s in spills)
+        rd, ri = resident.query_topk(q, M)
+        td, ti = tiered.query_topk(q, M)
+        assert (td == rd).all() and (ti == ri).all()
+
+        durable.save_index(tiered, snap)
+        status = durable.verify_snapshot(snap)
+        assert status["ok"], status
+        assert status["tier"]["cold_chunks"] == 3
+        restored = durable.load_index(snap)
+        xd, xi = restored.query_topk(q, M)
+        assert (xd == rd).all() and (xi == ri).all()
+        restored.close()
+    finally:
+        tiered.close()
+        resident.close()
+
+
+def test_tier_block_validator(tmp_path):
+    codes = _codes()
+    tiered = _tiered(codes)
+    snap = str(tmp_path / "snap")
+    try:
+        durable.save_index(tiered, snap)
+    finally:
+        tiered.close()
+    manifest = durable.read_manifest(snap)
+    durable._check_tier_block(manifest)  # as written: fine
+    durable._check_tier_block({"chunks": []})  # pre-tier: no-op
+
+    bad = json.loads(json.dumps(manifest))
+    bad["tier"]["format"] = 2
+    with pytest.raises(ValueError, match="format"):
+        durable._check_tier_block(bad)
+    bad = json.loads(json.dumps(manifest))
+    bad["tier"]["cold_tier"] = "lukewarm"
+    with pytest.raises(ValueError, match="cold_tier"):
+        durable._check_tier_block(bad)
+    bad = json.loads(json.dumps(manifest))
+    bad["tier"]["chunks"][0]["tier"] = "lukewarm"
+    with pytest.raises(ValueError, match="residency tag"):
+        durable._check_tier_block(bad)
+    bad = json.loads(json.dumps(manifest))
+    bad["tier"]["chunks"][0]["rows"] += 1
+    with pytest.raises(ValueError, match="disagrees"):
+        durable._check_tier_block(bad)
+
+    # load_index runs the same validator: a corrupted tag fails loudly
+    with open(os.path.join(snap, durable.MANIFEST_NAME)) as f:
+        m = json.load(f)
+    m["tier"]["chunks"][0]["tier"] = "lukewarm"
+    with open(os.path.join(snap, durable.MANIFEST_NAME), "w") as f:
+        json.dump(m, f)
+    with pytest.raises(ValueError, match="residency tag"):
+        durable.load_index(snap)
+    assert not durable.verify_snapshot(snap)["ok"]
+
+
+def test_compact_resets_tier_generation(tmp_path):
+    codes, q = _codes(), _queries()
+    resident = _ingest(sk.SimHashIndex(codes[:0]), codes)
+    tiered = _tiered(
+        codes, cold_tier="disk", cold_dir=str(tmp_path / "cold")
+    )
+    try:
+        dead = np.arange(50)
+        resident.delete(dead)
+        resident.compact()
+        tiered.delete(dead)
+        tiered.compact()
+        # old-generation spills are unlinked; the rebuilt chunk
+        # re-tiers (gen 2 spill names) under the same budget
+        names = os.listdir(tmp_path / "cold")
+        assert names and all("-000001-" not in n for n in names)
+        # compact remaps global ids identically on both indexes
+        rd, ri = resident.query_topk(q, M)
+        td, ti = tiered.query_topk(q, M)
+        assert (td == rd).all() and (ti == ri).all()
+    finally:
+        tiered.close()
+        resident.close()
+
+
+def test_close_is_idempotent():
+    idx = _tiered(_codes())
+    idx.close()
+    idx.close()
+
+
+# -- telemetry / doctor ------------------------------------------------------
+
+
+def test_doctor_residency_section(tmp_path):
+    from randomprojection_tpu.utils import trace_report
+
+    path = str(tmp_path / "events.jsonl")
+    events = [
+        {"event": "index.tier.hit", "hot_rows": 300, "cold_rows": 100},
+        {"event": "index.tier.fetch", "rows": 100, "bytes": 800,
+         "wall_s": 0.01, "overlap_s": 0.02, "source": "host",
+         "sync": False, "promote": False},
+        {"event": "index.tier.fetch", "rows": 100, "bytes": 800,
+         "wall_s": 0.03, "overlap_s": 0.0, "source": "host",
+         "sync": True, "promote": False},
+        {"event": "index.tier.fetch", "rows": 100, "bytes": 800,
+         "wall_s": 0.02, "overlap_s": 0.0, "source": "host",
+         "sync": False, "promote": True},
+        {"event": "index.tier.evict", "rows": 100, "bytes": 800,
+         "tier": "disk", "wall_s": 0.005},
+        {"event": "index.tier.fallback", "reason": "upload:RuntimeError",
+         "rows": 100},
+    ]
+    with open(path, "w") as f:
+        for ts, e in enumerate(events):
+            f.write(json.dumps({"ts": float(ts), "v": 2, **e}) + "\n")
+    report = trace_report.build_report(path)
+    rs = report["residency"]
+    assert rs["tiles"] == 1
+    assert rs["hot_rows"] == 300 and rs["cold_rows"] == 100
+    assert rs["hot_hit_ratio"] == 0.75
+    # the promote fetch is churn, not serving traffic
+    assert rs["cold_fetches"] == 2 and rs["promotions"] == 1
+    assert rs["sync_fetches"] == 1
+    assert rs["cold_fetch_wall_s"] == pytest.approx(0.04)
+    assert rs["cold_fetch_overlapped_s"] == pytest.approx(0.02)
+    assert rs["cold_fetch_p99_s"] == pytest.approx(0.03)
+    assert rs["demotions"] == 1
+    assert rs["fallbacks"] == {"upload:RuntimeError": 1}
+    # the fallback is on the degraded audit (RP02 consumption contract)
+    assert report["degraded"]["index.tier.fallback"] == 1
+    text = trace_report.render_report(report)
+    assert "residency (tiered hot/cold corpus" in text
+    assert "hot-hit ratio 0.7500" in text
+    assert "degraded sync fallbacks: 1" in text
+
+
+def test_no_residency_section_without_tier_events(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"ts": 0.0, "v": 2, "event": "hash.batch"})
+                + "\n")
+    from randomprojection_tpu.utils import trace_report
+
+    report = trace_report.build_report(path)
+    assert report["residency"] is None
+    assert ("residency (tiered hot/cold corpus"
+            not in trace_report.render_report(report))
+
+
+def test_tier_events_registered():
+    from randomprojection_tpu.utils.telemetry import EVENTS
+
+    assert EVENTS.INDEX_TIER_HIT == "index.tier.hit"
+    assert EVENTS.INDEX_TIER_FETCH == "index.tier.fetch"
+    assert EVENTS.INDEX_TIER_EVICT == "index.tier.evict"
+    assert EVENTS.INDEX_TIER_FALLBACK == "index.tier.fallback"
+    assert COLD_TIERS == ("host", "disk")
+
+
+# -- bench record ------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_tiered_record_shape():
+    from randomprojection_tpu import benchmark as B
+
+    rec = B.measure_topk_tiered("smoke")
+    assert rec["parity_ok"] is True
+    assert rec["over_budget_factor"] == 4.0
+    assert rec["hot_hit_fraction"] is None or 0 <= rec["hot_hit_fraction"] <= 1
+    assert rec["cold_fetch_overlapped_s"] >= 0
+    assert isinstance(rec["timing_suspect"], bool)
+    c = B.compact_summary({
+        "config4": {"topk_serving": {"queries_per_s": 1.0, "tiered": rec}}
+    })
+    c4 = c["config4"]
+    assert c4["topk_tiered_parity_ok"] is True
+    assert "topk_tiered_hot_hit_fraction" in c4
+    assert "topk_tiered_cold_fetch_p99_s" in c4
