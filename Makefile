@@ -97,13 +97,16 @@ lint:
 
 lint-ci:
 	$(PYTHON) -m randomprojection_tpu lint --json \
-	  --baseline .rplint_baseline.json > /dev/null \
-	  || { rc=$$?; \
+	  --baseline .rplint_baseline.json > .rplint_ci.json \
+	  || { rc=$$?; rm -f .rplint_ci.json; \
 	       $(PYTHON) -m randomprojection_tpu lint --baseline .rplint_baseline.json; \
 	       echo "lint-ci: to ACCEPT intended new findings (and prune stale baseline entries), run:"; \
 	       echo "  $(PYTHON) -m randomprojection_tpu lint --baseline .rplint_baseline.json --update-baseline"; \
 	       echo "then commit the rewritten .rplint_baseline.json."; \
 	       exit $$rc; }
+	@$(PYTHON) -c "import json; r = json.load(open('.rplint_ci.json')); \
+	print('lint-ci: %d file(s) in %.3fs (process-pool fan-out)' % (r['files'], r['wall_s']))"
+	@rm -f .rplint_ci.json
 	@echo "lint-ci OK: zero non-baselined findings"
 	@echo "  (baseline workflow: 'lint --baseline .rplint_baseline.json --update-baseline' rewrites the baseline in place; '--sarif PATH' emits SARIF 2.1.0 for CI annotation)"
 

@@ -1,4 +1,5 @@
-"""Flow-sensitive rplint rules RP07-RP11 (ISSUE 11, grown by ISSUE 12).
+"""Flow-sensitive rplint rules RP07-RP14 (ISSUE 11, grown by ISSUE 12
+and ISSUE 20).
 
 Built on the ``cfg`` substrate.  Each rule function returns plain
 ``(line, message)`` pairs; ``rplint.py`` wraps them into findings,
@@ -48,13 +49,44 @@ runs on).
   level through the package index) must be acyclic, and no blocking
   call (``queue.put`` / ``.join`` / ``future.result``) may run while a
   lock is held.
+- **RP12 resource lifecycle** (ISSUE 20) — RP01's span-balance engine
+  generalized into a paired-acquire/release protocol checker: a
+  telemetry subscription, ``MetricsServer``, ``HealthEngine``,
+  ``open()`` handle, ``np.memmap``, or ``mkdtemp`` temp dir bound to a
+  local name must be released on every path out of the acquiring
+  function (``exit_reachable_without`` on the CFG, guard facts
+  synthesized for ``if x is not None:``-style release guards), and —
+  the r17 bug shape — no second acquire may run outside an
+  exception-protected region while an earlier handle is still
+  unreleased.  Escaping handles (returned, yielded, stored on an
+  object, packed into a container, passed to an owning callee, or
+  captured by a nested function) are exempt, like RP01/RP08.
+- **RP13 durable-commit discipline** (ISSUE 20) — every ``os.replace``
+  landing a snapshot/artifact must be preceded by flush+fsync on all
+  paths, a raw ``open(final_path, "w")`` write (no tmp→replace) is a
+  finding, the manifest replace must be dominated by every chunk/spill
+  write in the same commit (manifest-committed-LAST, via the dominator
+  query, with conditional writes promoted to their enclosing
+  ``if``/loop headers), and a directory fsync must be reachable after
+  the replace (helper functions whose callers fsync the directory are
+  exempt).
+- **RP14 degraded-path audit** (ISSUE 20) — every fallback rung (a
+  broad ``except`` whose body is more than a bare re-raise) must
+  reachably emit a ``DEGRADED_EVENTS``-consumed telemetry event or
+  call a degraded-rung recorder; classified-failure rungs (those that
+  re-raise unrecognized errors) must memoize the degraded key (the r6
+  ``_NO_*_KEYS`` convention — the ``.add()`` may sit after the ladder
+  loop, so reachability is CFG-checked, not lexical); and a
+  ``counter_inc("*fallback*")`` without an adjacent degraded-event
+  emit in the same block is flagged.
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from randomprojection_tpu.analysis.cfg import (
     CFG,
@@ -79,6 +111,9 @@ __all__ = [
     "rule_rp09",
     "rule_rp10",
     "rule_rp11",
+    "rule_rp12",
+    "rule_rp13",
+    "rule_rp14",
 ]
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -1610,5 +1645,707 @@ def rule_rp11(tree: ast.Module, relpath: str,
             f"{_BLOCKING_CALLS[what]}; move the blocking call outside "
             "the lock region",
         ))
+    out.sort()
+    return out
+
+
+# -- shared call-shape primitives for RP12-RP14 (ISSUE 20) -------------------
+
+
+def _call_last(call: ast.Call) -> str:
+    """Last component of the callee name ('' when dynamic)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _call_base(call: ast.Call) -> str:
+    """Last component of the callee's receiver ('' for bare names)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return _dotted(f.value).split(".")[-1]
+    return ""
+
+
+# -- RP12: resource lifecycle (ISSUE 20) -------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Resource:
+    """One paired-acquire/release protocol RP12 enforces."""
+
+    what: str
+    close_attrs: Tuple[str, ...]      # x.<attr>() releases the handle
+    release_callees: Tuple[str, ...]  # <callee>(x, ...) releases it
+    advice: str
+
+
+_RP12_SUBSCRIPTION = _Resource(
+    "telemetry subscription", ("close",), ("unsubscribe",),
+    "unsubscribe it (or close the Subscription) in a finally",
+)
+_RP12_METRICS = _Resource(
+    "MetricsServer", ("close", "shutdown"), (),
+    "close() it in a finally or use it as a context manager",
+)
+_RP12_HEALTH = _Resource(
+    "HealthEngine", ("close",), (),
+    "close() it in a finally",
+)
+_RP12_OPEN = _Resource(
+    "open() handle", ("close",), (),
+    "use a with block or close() it in a finally",
+)
+_RP12_MEMMAP = _Resource(
+    "np.memmap handle", ("close",), (),
+    "close the underlying mmap (handle._mmap.close()) or hand the "
+    "handle to an owner",
+)
+_RP12_TMPDIR = _Resource(
+    "mkdtemp temp dir", (), ("rmtree",),
+    "shutil.rmtree() it in a finally",
+)
+
+#: Passing a handle to one of these callees is the *release*, not an
+#: ownership transfer — it must not exempt the acquire as an escape.
+_RP12_NON_OWNING_CALLEES = frozenset({"unsubscribe", "rmtree"})
+
+
+def _rp12_acquire(value: ast.AST) -> Optional[Tuple[ast.Call, _Resource]]:
+    """The tracked acquire call inside an Assign value, unwrapping
+    ``a if c else b`` / ``a or b`` alternatives and the
+    ``Ctor(...).start()`` chained form (``.start()`` returns self)."""
+    if isinstance(value, ast.IfExp):
+        return _rp12_acquire(value.body) or _rp12_acquire(value.orelse)
+    if isinstance(value, ast.BoolOp):
+        for v in value.values:
+            m = _rp12_acquire(v)
+            if m is not None:
+                return m
+        return None
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if (isinstance(f, ast.Attribute) and f.attr == "start"
+            and isinstance(f.value, ast.Call)):
+        return _rp12_acquire(f.value)
+    name, base = _call_last(value), _call_base(value)
+    if name == "subscribe" and (isinstance(f, ast.Name)
+                                or base == "telemetry"):
+        return value, _RP12_SUBSCRIPTION
+    if name == "MetricsServer":
+        return value, _RP12_METRICS
+    if name == "HealthEngine":
+        return value, _RP12_HEALTH
+    if name == "open" and isinstance(f, ast.Name):
+        return value, _RP12_OPEN
+    if name == "memmap" and base in ("np", "numpy"):
+        return value, _RP12_MEMMAP
+    if name == "mkdtemp":
+        return value, _RP12_TMPDIR
+    return None
+
+
+def _rp12_guard_facts(name: str) -> frozenset:
+    """Branch facts consistent with ``name`` holding a live handle, so
+    ``if x is not None: x.close()``-style guarded releases count: the
+    pruned paths are exactly the ones with nothing to release."""
+    def dump(expr: str) -> str:
+        return ast.dump(ast.parse(expr, mode="eval").body)
+
+    return frozenset({
+        (dump(f"{name} is not None"), True),
+        (dump(f"{name} is None"), False),
+        (dump(name), True),
+    })
+
+
+def _rp12_aliases(value: ast.AST) -> Iterator[str]:
+    """Names the assigned expression may directly BE (so ``y = x``
+    aliases but ``data = x.read()`` — a derived value — does not)."""
+    if isinstance(value, ast.Name):
+        yield value.id
+    elif isinstance(value, ast.IfExp):
+        yield from _rp12_aliases(value.body)
+        yield from _rp12_aliases(value.orelse)
+    elif isinstance(value, ast.BoolOp):
+        for v in value.values:
+            yield from _rp12_aliases(v)
+    elif isinstance(value, ast.NamedExpr):
+        yield from _rp12_aliases(value.value)
+
+
+def _rp12_escapes(func: ast.AST, name: str, res: _Resource) -> bool:
+    """The handle outlives (or is owned beyond) this function: it is
+    returned/yielded, packed into a container, re-bound (aliased or
+    stored on an object — another owner can release it), passed to a
+    callee that is not the paired release, or captured by a nested
+    def/lambda."""
+    def contains(sub: ast.AST) -> bool:
+        return any(isinstance(x, ast.Name) and x.id == name
+                   for x in ast.walk(sub))
+
+    for n in _own_nodes(func):
+        if isinstance(n, ast.Return):
+            if n.value is not None and contains(n.value):
+                return True
+        elif isinstance(n, (ast.Yield, ast.YieldFrom)):
+            if n.value is not None and contains(n.value):
+                return True
+        elif isinstance(n, (ast.Tuple, ast.List, ast.Set)):
+            if any(isinstance(e, ast.Name) and e.id == name
+                   for e in n.elts):
+                return True
+        elif isinstance(n, ast.Dict):
+            vals = list(n.keys) + list(n.values)
+            if any(isinstance(v, ast.Name) and v.id == name
+                   for v in vals if v is not None):
+                return True
+        elif isinstance(n, ast.Call):
+            if (_call_last(n) in res.release_callees
+                    or _call_last(n) in _RP12_NON_OWNING_CALLEES):
+                continue
+            if any(contains(a) for a in n.args) or any(
+                    contains(k.value) for k in n.keywords):
+                return True
+        elif isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if n.value is not None and name in _rp12_aliases(n.value):
+                return True
+        elif isinstance(n, (ast.Global, ast.Nonlocal)):
+            if name in n.names:
+                return True
+    for nd in ast.walk(func):
+        if nd is not func and isinstance(nd, _FUNC_NODES + (ast.Lambda,)):
+            if any(isinstance(x, ast.Name) and x.id == name
+                   for x in ast.walk(nd)):
+                return True
+    return False
+
+
+def _rp12_release_nodes(cfg: CFG, name: str, res: _Resource) -> Set[int]:
+    """CFG nodes that release the handle: ``x.close()``-style calls
+    (receiver rooted at ``x``, so ``x._mmap.close()`` counts),
+    ``unsubscribe(x)``-style release callees, ``del x``, and
+    ``with x:`` / ``with closing(x):`` headers."""
+    rel: Set[int] = set()
+    for node in cfg.nodes:
+        st = node.stmt
+        if st is None:
+            continue
+        if isinstance(st, ast.Delete) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in st.targets):
+            rel.add(node.idx)
+            continue
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Name) and ce.id == name:
+                    rel.add(node.idx)
+                elif (isinstance(ce, ast.Call) and ce.args
+                      and isinstance(ce.args[0], ast.Name)
+                      and ce.args[0].id == name):
+                    rel.add(node.idx)
+        for sub in shallow_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if (isinstance(f, ast.Attribute) and f.attr in res.close_attrs
+                    and _dotted(f.value).split(".")[0] == name):
+                rel.add(node.idx)
+            elif _call_last(sub) in res.release_callees and any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in sub.args):
+                rel.add(node.idx)
+    return rel
+
+
+def _rp12_reach(cfg: CFG, start: int, targets: Set[int],
+                blocked: Set[int], facts: frozenset) -> bool:
+    """``node_reachable_without`` that ignores the start node's own
+    exception edge: if the acquire statement itself raises, the handle
+    was never bound — only exceptions *after* the bind can leak it."""
+    seen: Set[int] = set()
+    stack: List[int] = []
+    for s, fact in cfg.nodes[start].succs:
+        if cfg.nodes[s].kind == "anchor":
+            continue
+        if s in blocked:
+            continue
+        if fact is not None and (fact[0], not fact[1]) in facts:
+            continue
+        if s not in seen:
+            seen.add(s)
+            stack.append(s)
+    while stack:
+        n = stack.pop()
+        for s, fact in cfg.nodes[n].succs:
+            if s in seen or s in blocked:
+                continue
+            if fact is not None and (fact[0], not fact[1]) in facts:
+                continue
+            seen.add(s)
+            stack.append(s)
+    return bool(targets & seen)
+
+
+def rule_rp12(tree: ast.Module) -> List[Tuple[int, str]]:
+    """Resource lifecycle: paired acquire/release on every path, plus
+    the r17 acquire-ordering leg (a later acquire outside any try while
+    an earlier handle is live leaks the earlier handle if it raises)."""
+    out: List[Tuple[int, str]] = []
+    for func in (n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)):
+        _rp12_function(func, out)
+    out.sort()
+    return out
+
+
+def _rp12_function(func: ast.AST, out: List[Tuple[int, str]]) -> None:
+    cfg = build_cfg(func)
+    acquires = []
+    for node in cfg.nodes:
+        st = node.stmt
+        if node.kind != "stmt" or not isinstance(st, ast.Assign):
+            continue
+        if len(st.targets) != 1 or not isinstance(st.targets[0], ast.Name):
+            continue  # attribute/tuple targets: the owner releases it
+        m = _rp12_acquire(st.value)
+        if m is not None:
+            acquires.append((node.idx, st.lineno, st.targets[0].id, m[1]))
+    if not acquires:
+        return
+    acquires.sort(key=lambda a: a[1])
+    balanced = []
+    for idx, line, name, res in acquires:
+        if _rp12_escapes(func, name, res):
+            continue
+        rel = _rp12_release_nodes(cfg, name, res)
+        facts = cfg.nodes[idx].facts | _rp12_guard_facts(name)
+        if _rp12_reach(cfg, idx, {cfg.exit}, rel, facts):
+            out.append((
+                line,
+                f"{res.what} {name!r} is not released on every path out "
+                f"of {getattr(func, 'name', '<fn>')}() — {res.advice}; "
+                "escaping handles (returned/stored/passed to an owner) "
+                "are exempt",
+            ))
+        else:
+            balanced.append((idx, line, name, res, rel, facts))
+    for b_idx, b_line, b_name, b_res in acquires:
+        node = cfg.nodes[b_idx]
+        if any(cfg.nodes[s].kind == "anchor" for s, _ in node.succs):
+            continue  # exception-protected: the handler owns cleanup
+        for a_idx, a_line, a_name, _a_res, rel, facts in balanced:
+            if a_idx == b_idx or a_line >= b_line:
+                continue
+            if _rp12_reach(cfg, a_idx, {b_idx}, rel, facts):
+                out.append((
+                    b_line,
+                    f"{b_res.what} {b_name!r} is acquired while "
+                    f"{a_name!r} (line {a_line}) is still unreleased and "
+                    "this statement is not exception-protected — if this "
+                    f"acquire raises, {a_name!r} leaks; release it in an "
+                    "except before re-raising, or move this acquire under "
+                    "the existing try",
+                ))
+                break
+
+
+# -- RP13: durable-commit discipline (ISSUE 20) ------------------------------
+
+_RP13_MANIFEST_CALLEES = ("_commit_manifest", "_write_manifest")
+_RP13_ARTIFACT_CALLEES = ("_write_npy_atomic", "_spill_chunk")
+_RP13_WRITE_MODES = ("w", "wb", "x", "xb", "w+", "wb+", "w+b", "x+b")
+_RP13_DIRFSYNC_RE = re.compile(r"fsync\w*dir|dir\w*fsync", re.I)
+
+
+def _rp13_carriers(tree: ast.Module) -> Set[str]:
+    """Module function names whose body reaches a directory-fsync
+    (``_fsync_dir``-shaped name), transitively within the module, so a
+    call to ``_commit_manifest`` counts as the caller's dir fsync."""
+    fns = {n.name: n for n in ast.walk(tree)
+           if isinstance(n, _FUNC_NODES)}
+    carriers = {
+        name for name, fn in fns.items()
+        if _RP13_DIRFSYNC_RE.search(name) or any(
+            isinstance(sub, ast.Call)
+            and _RP13_DIRFSYNC_RE.search(_call_last(sub))
+            for sub in ast.walk(fn)
+        )
+    }
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in fns.items():
+            if name in carriers:
+                continue
+            if any(isinstance(sub, ast.Call)
+                   and _call_last(sub) in carriers
+                   for sub in ast.walk(fn)):
+                carriers.add(name)
+                changed = True
+    return carriers
+
+
+def _rp13_is_dirfsync(call: ast.Call, carriers: Set[str]) -> bool:
+    name = _call_last(call)
+    return bool(_RP13_DIRFSYNC_RE.search(name)) or name in carriers
+
+
+def _rp13_tmp_path(path_arg: ast.AST, replaces: List[ast.Call]) -> bool:
+    """The opened path is a tmp staging path: it textually names a tmp,
+    or it is the *source* of some ``os.replace`` in the same function
+    (matched by name or by expression shape)."""
+    if isinstance(path_arg, ast.Name) and "tmp" in path_arg.id.lower():
+        return True
+    for sub in ast.walk(path_arg):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            low = sub.value.lower()
+            if "tmp" in low or ".partial" in low:
+                return True
+    want = ast.dump(path_arg)
+    for r in replaces:
+        if not r.args:
+            continue
+        src = r.args[0]
+        if isinstance(path_arg, ast.Name) and isinstance(src, ast.Name):
+            if path_arg.id == src.id:
+                return True
+        elif ast.dump(src) == want:
+            return True
+    return False
+
+
+def rule_rp13(tree: ast.Module) -> List[Tuple[int, str]]:
+    """Durable-commit discipline: tmp→flush→fsync→``os.replace`` for
+    every artifact landing, manifest committed last (dominator query),
+    and a directory fsync reachable after the replace."""
+    out: List[Tuple[int, str]] = []
+    carriers = _rp13_carriers(tree)
+    fns = [n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)]
+    cfgs: Dict[int, CFG] = {}
+
+    def cfg_of(fn: ast.AST) -> CFG:
+        c = cfgs.get(id(fn))
+        if c is None:
+            c = cfgs[id(fn)] = build_cfg(fn)
+        return c
+
+    for func in fns:
+        _rp13_function(func, tree, fns, carriers, cfg_of, out)
+    out.sort()
+    return out
+
+
+def _rp13_caller_fsyncs_dir(func_name: str, fns, carriers, cfg_of) -> bool:
+    """Some same-module caller of ``func_name`` has a directory fsync
+    reachable after the call site — the helper delegates durability of
+    the parent directory to its callers (the ``_write_npy_atomic``
+    pattern: ``save_index`` commits the manifest, whose commit fsyncs
+    the directory)."""
+    for g in fns:
+        cfg_g = cfg_of(g)
+        call_nodes, dir_nodes = set(), set()
+        for node in cfg_g.nodes:
+            for sub in shallow_walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _call_last(sub) == func_name:
+                    call_nodes.add(node.idx)
+                if _rp13_is_dirfsync(sub, carriers):
+                    dir_nodes.add(node.idx)
+        for c in call_nodes:
+            if node_reachable_without(cfg_g, c, dir_nodes, set()):
+                return True
+    return False
+
+
+def _rp13_function(func, tree, fns, carriers, cfg_of, out) -> None:
+    cfg = cfg_of(func)
+    replace_nodes: Dict[int, ast.Call] = {}
+    fsync_nodes: Set[int] = set()
+    flush_nodes: Set[int] = set()
+    dirfsync_nodes: Set[int] = set()
+    manifest_nodes: Dict[int, int] = {}
+    artifact_nodes: Dict[int, int] = {}
+    opens: List[Tuple[int, ast.Call]] = []
+    for node in cfg.nodes:
+        for sub in shallow_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name, base = _call_last(sub), _call_base(sub)
+            if name == "replace" and base == "os":
+                replace_nodes[node.idx] = sub
+            elif name == "fsync" and base == "os":
+                fsync_nodes.add(node.idx)
+            elif name == "flush":
+                flush_nodes.add(node.idx)
+            if _rp13_is_dirfsync(sub, carriers):
+                dirfsync_nodes.add(node.idx)
+            if name in _RP13_MANIFEST_CALLEES:
+                manifest_nodes[node.idx] = sub.lineno
+            elif name in _RP13_ARTIFACT_CALLEES:
+                artifact_nodes[node.idx] = sub.lineno
+            if isinstance(sub.func, ast.Name) and sub.func.id == "open":
+                opens.append((node.idx, sub))
+
+    replaces = list(replace_nodes.values())
+
+    # leg B: raw write to a final path (no tmp→replace staging)
+    for _idx, sub in opens:
+        mode = None
+        if len(sub.args) >= 2 and isinstance(sub.args[1], ast.Constant):
+            mode = sub.args[1].value
+        for k in sub.keywords:
+            if k.arg == "mode" and isinstance(k.value, ast.Constant):
+                mode = k.value.value
+        if not (isinstance(mode, str) and mode in _RP13_WRITE_MODES):
+            continue
+        if not sub.args:
+            continue
+        if _rp13_tmp_path(sub.args[0], replaces):
+            continue
+        out.append((
+            sub.lineno,
+            f"raw open(..., {mode!r}) writes the final path in place — "
+            "a crash mid-write leaves a torn artifact; write a tmp "
+            "sibling, flush+fsync it, then os.replace onto the final "
+            "path",
+        ))
+
+    # legs A and D: per os.replace
+    for r_idx, rcall in replace_nodes.items():
+        missing = []
+        if node_reachable_without(cfg, cfg.entry, {r_idx}, flush_nodes):
+            missing.append("a flush")
+        if node_reachable_without(cfg, cfg.entry, {r_idx}, fsync_nodes):
+            missing.append("an os.fsync")
+        if missing:
+            out.append((
+                rcall.lineno,
+                "os.replace is reachable without "
+                + " or ".join(missing)
+                + " on the staged tmp file — a crash after the rename "
+                "can publish an artifact whose bytes never hit disk; "
+                "flush+fsync the tmp handle before replacing",
+            ))
+            continue
+        if not node_reachable_without(cfg, r_idx, dirfsync_nodes, set()):
+            fname = getattr(func, "name", "")
+            if _rp13_caller_fsyncs_dir(fname, fns, carriers, cfg_of):
+                continue
+            out.append((
+                rcall.lineno,
+                "no directory fsync is reachable after this os.replace "
+                "— the rename itself can be lost on crash; fsync the "
+                "parent directory (or delegate to a caller that does)",
+            ))
+
+    # leg C: manifest committed LAST (dominated by every artifact write)
+    if manifest_nodes and artifact_nodes:
+        dom = dominators(cfg)
+        parents = _parents_map(func)
+        header_of = {
+            id(node.stmt): node.idx
+            for node in cfg.nodes
+            if node.kind in ("branch", "loop") and node.stmt is not None
+        }
+
+        def promote(a_idx: int) -> int:
+            # a write under an if/loop is represented by its outermost
+            # enclosing header: the header is on every path even when
+            # the conditional write is skipped (zero-trip loops,
+            # nothing-to-spill branches)
+            cur = a_idx
+            p = parents.get(cfg.nodes[a_idx].stmt)
+            while p is not None and not isinstance(p, _FUNC_NODES):
+                if (isinstance(p, (ast.If, ast.For, ast.While))
+                        and id(p) in header_of):
+                    cur = header_of[id(p)]
+                p = parents.get(p)
+            return cur
+
+        for m_idx, m_line in manifest_nodes.items():
+            for a_idx, a_line in artifact_nodes.items():
+                if promote(a_idx) not in dom[m_idx]:
+                    out.append((
+                        m_line,
+                        "manifest commit is not dominated by the "
+                        f"chunk/spill write at line {a_line} — the "
+                        "manifest must be replaced LAST, after every "
+                        "artifact it names is durable, or recovery "
+                        "reads a manifest pointing at missing bytes",
+                    ))
+                    break
+
+
+# -- RP14: degraded-path audit (ISSUE 20) ------------------------------------
+
+_RP14_RECORDERS = (
+    "note_fallback", "record_vmem_oom_retry", "record_dma_fallback",
+)
+_RP14_MEMO_RE = re.compile(r"no_\w*keys$|degraded", re.I)
+
+
+def _rp14_degraded_emit(call: ast.Call, degraded: Optional[Set[str]]
+                        ) -> Optional[str]:
+    """What this call reports to the degraded-path plane: an
+    ``EVENTS.<X>`` emit with X consumed by trace_report's
+    DEGRADED_EVENTS (any EVENTS attr when ``degraded`` is None), or a
+    recorder helper; None when it reports nothing."""
+    name = _call_last(call)
+    if name in _RP14_RECORDERS:
+        return name
+    if name == "emit" and call.args:
+        a0 = call.args[0]
+        if isinstance(a0, ast.Attribute):
+            base = _dotted(a0.value)
+            if base == "EVENTS" or base.endswith(".EVENTS"):
+                if degraded is None or a0.attr in degraded:
+                    return f"EVENTS.{a0.attr}"
+    return None
+
+
+def _rp14_memo_add(sub: ast.AST) -> bool:
+    return (isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "add"
+            and bool(_RP14_MEMO_RE.search(
+                _dotted(sub.func.value).split(".")[-1])))
+
+
+def rule_rp14(tree: ast.Module,
+              degraded: Optional[Set[str]] = None
+              ) -> List[Tuple[int, str]]:
+    """Degraded-path audit: every fallback rung (broad except whose
+    body is more than a bare re-raise) must reachably emit a
+    DEGRADED_EVENTS-consumed event or call a recorder; classified
+    rungs must memoize the degraded key (``_NO_*_KEYS`` / ``*degraded``
+    add, CFG-reachable from the handler — the r6 convention); and a
+    fallback counter without an adjacent emit is flagged."""
+    out: List[Tuple[int, str]] = []
+    parents = _parents_map(tree)
+
+    def enclosing_func(n: ast.AST) -> Optional[ast.AST]:
+        p = parents.get(n)
+        while p is not None and not isinstance(p, _FUNC_NODES):
+            p = parents.get(p)
+        return p
+
+    for func in (n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)):
+        cfg: Optional[CFG] = None
+        for h in (n for n in ast.walk(func)
+                  if isinstance(n, ast.ExceptHandler)):
+            if enclosing_func(h) is not func:
+                continue
+            t = h.type
+            broad = t is None or (
+                isinstance(t, (ast.Name, ast.Attribute))
+                and _dotted(t).split(".")[-1] in ("Exception",
+                                                  "BaseException"))
+            if not broad:
+                continue
+            if len(h.body) == 1 and isinstance(h.body[0], ast.Raise):
+                continue  # a bare re-raise is not a rung
+            emits = [
+                e for e in (
+                    _rp14_degraded_emit(sub, degraded)
+                    for sub in ast.walk(h) if isinstance(sub, ast.Call))
+                if e is not None
+            ]
+            if not emits:
+                out.append((
+                    h.lineno,
+                    "fallback rung (broad except that continues) never "
+                    "emits a DEGRADED_EVENTS-consumed telemetry event "
+                    "or calls a degraded-rung recorder ("
+                    + "/".join(_RP14_RECORDERS)
+                    + ") — trace_report's doctor cannot see this "
+                    "degradation",
+                ))
+                continue
+            classified = any(isinstance(s, ast.Raise) for s in ast.walk(h))
+            if not classified:
+                continue
+            if any(_rp14_memo_add(sub) for sub in ast.walk(h)):
+                continue
+            if cfg is None:
+                cfg = build_cfg(func)
+            h_nodes = {
+                node.idx for node in cfg.nodes
+                if node.stmt is h.body[0]
+            }
+            memo_nodes = {
+                node.idx for node in cfg.nodes
+                if any(_rp14_memo_add(sub) for sub in shallow_walk(node))
+            }
+            reachable = any(
+                node_reachable_without(cfg, hn, memo_nodes, set(),
+                                       frozenset())
+                for hn in h_nodes
+            )
+            if not reachable:
+                out.append((
+                    h.lineno,
+                    "classified-failure rung re-raises unrecognized "
+                    "errors but never memoizes the degraded key — no "
+                    "`_NO_*_KEYS`-style / `*degraded` .add() is "
+                    "reachable from the handler, so every later call "
+                    "re-pays the failed attempt (the r6 convention)",
+                ))
+
+    # counter-fallback adjacency: a fallback counter must sit next to
+    # the event emit in one of its own enclosing statement blocks
+    # (climbing stops at the function boundary, and block scans never
+    # descend into nested defs — another function's emit is not
+    # adjacency)
+    def block_of(node: ast.AST):
+        child, p = node, parents.get(node)
+        while p is not None:
+            if isinstance(child, ast.stmt):
+                for field in ("body", "orelse", "finalbody"):
+                    blk = getattr(p, field, None)
+                    if isinstance(blk, list) and child in blk:
+                        return p, blk
+            child, p = p, parents.get(p)
+        return None, None
+
+    def block_emits(blk) -> bool:
+        for st in blk:
+            for sub in [st, *_own_nodes(st)]:
+                if isinstance(sub, ast.Call) and (
+                        _rp14_degraded_emit(sub, degraded) is not None):
+                    return True
+        return False
+
+    for c in (sub for sub in ast.walk(tree) if isinstance(sub, ast.Call)):
+        if not (_call_last(c) == "counter_inc" and c.args
+                and isinstance(c.args[0], ast.Constant)
+                and isinstance(c.args[0].value, str)
+                and "fallback" in c.args[0].value):
+            continue
+        covered = False
+        node: ast.AST = c
+        while True:
+            owner, blk = block_of(node)
+            if blk is None:
+                break
+            if block_emits(blk):
+                covered = True
+                break
+            if owner is None or isinstance(owner, _FUNC_NODES):
+                break
+            node = owner
+        if not covered:
+            out.append((
+                c.lineno,
+                "fallback counter incremented without an adjacent "
+                "degraded-event emit in the same block — counters "
+                "aggregate but the doctor's timeline needs the event; "
+                "emit the matching EVENTS.* alongside",
+            ))
     out.sort()
     return out

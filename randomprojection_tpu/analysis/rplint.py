@@ -84,6 +84,32 @@ shape.
   call level deep) must be acyclic, and no blocking call
   (``queue.put``, ``.join``, ``future.result``) may run while a lock is
   held.
+- **RP12 resource lifecycle** (all modules; ISSUE 20) — RP01's
+  span-balance engine generalized to paired acquire/release protocols:
+  a telemetry subscription, ``MetricsServer``, ``HealthEngine``,
+  ``open()`` handle, ``np.memmap``, or ``mkdtemp`` temp dir bound to a
+  local must be released on every path out of the acquiring function
+  (escaping handles exempt, ``if x is not None:`` release guards
+  understood), and — the r17 bug shape — a later acquire outside any
+  try while an earlier handle is live is flagged: if it raises, the
+  earlier handle leaks.
+- **RP13 durable-commit discipline** (durable/tiering/telemetry/
+  streaming + the linter's own baseline writer; ISSUE 20) — every
+  artifact landing goes tmp→flush→fsync→``os.replace`` (a raw
+  ``open(final_path, "w")`` is a finding), the manifest replace is
+  dominated by every chunk/spill write of the same commit
+  (manifest-committed-LAST by dominator query), and a directory fsync
+  is reachable after the replace (helpers whose callers fsync the
+  directory are exempt).
+- **RP14 degraded-path audit** (kernel/LSH/tiering ladders; ISSUE 20)
+  — every fallback rung (broad except that continues) reachably emits
+  an event that ``trace_report.DEGRADED_EVENTS`` consumes or calls a
+  degraded-rung recorder; classified rungs memoize their degraded key
+  (the r6 ``_NO_*_KEYS`` convention, CFG-reachability checked so the
+  post-success ``.add()`` after a ladder loop counts); fallback
+  counters need an adjacent event emit; and — the RP02-style reverse
+  leg — every ``DEGRADED_EVENTS`` member must exist in the registry
+  and be emitted somewhere outside trace_report.
 
 Suppression pragma (same line as the finding, the line directly above
 it, or any physical line of the same logical statement — so pragmas on
@@ -117,12 +143,14 @@ from __future__ import annotations
 
 import argparse
 import ast
+import concurrent.futures
 import dataclasses
 import io
 import json
 import os
 import re
 import sys
+import time
 import tokenize
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
@@ -140,6 +168,8 @@ __all__ = [
     "EventRegistry",
     "load_event_registry",
     "check_registry_drift",
+    "load_degraded_events",
+    "check_degraded_drift",
     "diff_baseline",
     "lint_source",
     "lint_package",
@@ -184,6 +214,20 @@ RULES = {
     "RP11": "lock-order deadlocks: the lock-acquisition ordering graph "
             "must be acyclic, and no blocking call (queue.put / .join / "
             "future.result) may run while a lock is held",
+    "RP12": "resource lifecycle: subscriptions, MetricsServer, "
+            "HealthEngine, open()/np.memmap handles and mkdtemp dirs are "
+            "released on every path out of the acquiring function, and "
+            "no unprotected later acquire can leak an earlier live "
+            "handle (the r17 bug shape)",
+    "RP13": "durable-commit discipline: artifact writes go tmp→flush→"
+            "fsync→os.replace, the manifest is committed last (dominated "
+            "by every chunk/spill write), and a directory fsync is "
+            "reachable after the replace",
+    "RP14": "degraded-path audit: every fallback rung emits a "
+            "DEGRADED_EVENTS-consumed event or calls a recorder, "
+            "classified rungs memoize their degraded key, fallback "
+            "counters sit next to their emit, and every DEGRADED_EVENTS "
+            "member is registered and emitted somewhere",
 }
 
 # -- rule scoping (paths are package-relative, '/'-separated) ----------------
@@ -271,6 +315,29 @@ CONCURRENCY_MODULES = (
     # (residency swaps) — emit-outside-lock and never-put-under-lock are
     # exactly its correctness story
     "tiering.py",
+)
+# RP13 (ISSUE 20): the modules that land durable artifacts — the
+# snapshot/spill writers, the flight-recorder dump, the stream cursor,
+# and the linter's own baseline/SARIF writer (it must practice the
+# commit idiom it preaches)
+RP13_MODULES = (
+    "durable.py",
+    "tiering.py",
+    "utils/telemetry.py",
+    "streaming.py",
+    "analysis/rplint.py",
+)
+# RP14 (ISSUE 20): the ladder modules whose fallback rungs the doctor
+# must be able to see — the kernel VMEM/DMA ladders, the LSH probe
+# ladder, the residency tier ladder, and the serving-side fallbacks
+RP14_MODULES = (
+    "ops/pallas_kernels.py",
+    "ops/topk_kernels.py",
+    "ops/probe_kernels.py",
+    "ann/lsh.py",
+    "tiering.py",
+    "models/sketch.py",
+    "backends/jax_backend.py",
 )
 # RP05: Generator-construction surface of np.random that stays legal
 RNG_FACTORY_OK = frozenset(
@@ -579,6 +646,69 @@ def check_registry_drift(
     return findings
 
 
+# -- the degraded-events contract (RP14, reverse leg) ------------------------
+
+
+def load_degraded_events(consumer_text: str) -> Tuple[Set[str], int]:
+    """Parse trace_report's ``DEGRADED_EVENTS = (EVENTS.X, ...)`` tuple
+    into the attr-name set RP14's emit matching consumes, plus the
+    assignment's line (so reverse-leg findings anchor there).  Returns
+    ``(set(), 1)`` when the consumer is missing or unparsable — RP14's
+    forward leg then accepts any ``EVENTS.*`` emit."""
+    try:
+        tree = ast.parse(consumer_text)
+    except SyntaxError:
+        return set(), 1
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "DEGRADED_EVENTS"
+                   for t in n.targets):
+            continue
+        attrs = {
+            sub.attr for sub in ast.walk(n.value)
+            if isinstance(sub, ast.Attribute)
+            and _dotted(sub.value).split(".")[-1] == "EVENTS"
+        }
+        return attrs, n.lineno
+    return set(), 1
+
+
+def check_degraded_drift(
+    degraded: Set[str],
+    degraded_line: int,
+    registry: EventRegistry,
+    sources: Sequence[Tuple[str, str]],
+    consumer_relpath: str = TRACE_REPORT_MODULE,
+) -> List[Finding]:
+    """RP14, reverse leg (the RP02 shape): every DEGRADED_EVENTS member
+    must exist in the telemetry registry AND be emitted by some module
+    other than trace_report — a consumed-but-never-produced degraded
+    event means the doctor watches a signal nothing can raise."""
+    findings: List[Finding] = []
+    for attr in sorted(degraded):
+        if attr not in registry.events and attr not in registry.family_attrs:
+            findings.append(Finding(
+                "RP14", consumer_relpath, degraded_line,
+                f"DEGRADED_EVENTS names EVENTS.{attr}, which is not a "
+                "telemetry registry member — the doctor consumes an "
+                "event that cannot exist",
+            ))
+            continue
+        pat = re.compile(rf"EVENTS\.{re.escape(attr)}\b")
+        if not any(
+            pat.search(src) for rel, src in sources
+            if rel != consumer_relpath
+        ):
+            findings.append(Finding(
+                "RP14", consumer_relpath, degraded_line,
+                f"DEGRADED_EVENTS names EVENTS.{attr}, but no module "
+                "outside trace_report emits it — the doctor watches a "
+                "degraded signal nothing raises",
+            ))
+    return findings
+
+
 # -- rules -------------------------------------------------------------------
 
 
@@ -846,6 +976,22 @@ def _rule_rp04(tree: ast.Module, relpath: str,
                     "daemon= — decide (and document) whether this thread "
                     "may outlive interpreter shutdown",
                 ))
+        is_simple = (
+            isinstance(f, ast.Attribute) and f.attr == "SimpleQueue"
+            and _dotted(f.value).split(".")[-1] in ("queue", "_queue")
+        ) or (
+            isinstance(f, ast.Name) and f.id == "SimpleQueue"
+            and _imports_name(tree, "queue", "SimpleQueue")
+        )
+        if is_simple:
+            # SimpleQueue takes no maxsize at all — it is unbounded by
+            # construction, invisible to the maxsize heuristic below
+            out.append(Finding(
+                "RP04", relpath, n.lineno,
+                "queue.SimpleQueue() is unbounded by construction (it "
+                "accepts no maxsize) — a stalled consumer grows it "
+                "without limit; use queue.Queue(maxsize=...) instead",
+            ))
         is_queue = (
             isinstance(f, ast.Attribute) and f.attr in ("Queue", "LifoQueue")
             and _dotted(f.value).split(".")[-1] in ("queue", "_queue")
@@ -963,7 +1109,8 @@ def _rule_rp06(tree: ast.Module, relpath: str) -> List[Finding]:
 def lint_source(src: str, relpath: str, *,
                 registry: Optional[EventRegistry] = None,
                 index: Optional[PackageIndex] = None,
-                tree: Optional[ast.Module] = None) -> List[Finding]:
+                tree: Optional[ast.Module] = None,
+                degraded: Optional[Set[str]] = None) -> List[Finding]:
     """Lint one module's source.  ``relpath`` is the package-relative
     path ('/'-separated) the rule scoping keys on; tests lint fixture
     text under virtual relpaths to exercise module-scoped rules.
@@ -971,7 +1118,9 @@ def lint_source(src: str, relpath: str, *,
     call resolution; without it RP09 resolves same-file calls only.
     ``tree`` is an optional pre-parsed AST of ``src`` (``lint_package``
     passes the one it already built for the index, so targets parse
-    once per run)."""
+    once per run).  ``degraded`` is trace_report's parsed
+    DEGRADED_EVENTS attr set for RP14's emit matching; without it any
+    ``EVENTS.*`` emit satisfies a rung (the fixture path)."""
     relpath = relpath.replace(os.sep, "/")
     if tree is None:
         try:
@@ -985,8 +1134,12 @@ def lint_source(src: str, relpath: str, *,
     emit_imported = _imports_name(tree, "telemetry", "emit")
     # rules actually evaluated for this file — a pragma naming only
     # rules that never ran here cannot be judged stale
-    evaluated: Set[str] = {"RP01", "RP04", "RP08"}
+    evaluated: Set[str] = {"RP01", "RP04", "RP08", "RP12"}
     findings += _rule_rp01(tree, relpath, parents, emit_imported)
+    findings += [
+        Finding("RP12", relpath, ln, msg)
+        for ln, msg in flowrules.rule_rp12(tree)
+    ]
     if registry is not None:
         evaluated.add("RP02")
     findings += _rule_rp02(tree, relpath, registry, emit_imported)
@@ -1035,6 +1188,18 @@ def lint_source(src: str, relpath: str, *,
         findings += [
             Finding("RP11", relpath, ln, msg)
             for ln, msg in flowrules.rule_rp11(tree, relpath, index=index)
+        ]
+    if relpath in RP13_MODULES:
+        evaluated.add("RP13")
+        findings += [
+            Finding("RP13", relpath, ln, msg)
+            for ln, msg in flowrules.rule_rp13(tree)
+        ]
+    if relpath in RP14_MODULES:
+        evaluated.add("RP14")
+        findings += [
+            Finding("RP14", relpath, ln, msg)
+            for ln, msg in flowrules.rule_rp14(tree, degraded=degraded)
         ]
     for f in findings:
         if f.rule == "RP00" or f.severity != "error":
@@ -1123,18 +1288,57 @@ def _build_index(
     return idx, trees
 
 
+_POOL_STATE: dict = {}
+
+
+def _pool_init(sources: Sequence[Tuple[str, str]],
+               registry: Optional[EventRegistry],
+               degraded: Optional[Set[str]]) -> None:
+    """ProcessPool initializer: each worker builds the cross-module
+    index once, then lints the rels it is handed."""
+    index, trees = _build_index(sources)
+    _POOL_STATE.update(
+        sources=dict(sources), registry=registry, degraded=degraded,
+        index=index, trees=trees,
+    )
+
+
+def _pool_lint(rel: str) -> List[Finding]:
+    s = _POOL_STATE
+    return lint_source(
+        s["sources"][rel], rel, registry=s["registry"], index=s["index"],
+        tree=s["trees"].get(rel), degraded=s["degraded"],
+    )
+
+
+def default_jobs() -> int:
+    """Default lint parallelism: ``min(8, cpu)`` — the package is ~45
+    files, so more workers than that just pay fork+reindex cost."""
+    return min(8, os.cpu_count() or 1)
+
+
 def lint_package(root: Optional[str] = None,
-                 files: Optional[Sequence[str]] = None) -> dict:
+                 files: Optional[Sequence[str]] = None,
+                 jobs: Optional[int] = None) -> dict:
     """Lint the package tree (or an explicit file list) and return the
     stable findings record the CLI serializes with ``--json``:
     ``{rplint, root, files, findings[], counts, suppressed,
-    unresolvable_emits, ok}`` — rule id / path / line / message /
-    severity / pragma state per finding.  Raises on unreadable lint
-    targets (the CLI maps that to exit code 2)."""
+    unresolvable_emits, wall_s, ok}`` — rule id / path / line /
+    message / severity / pragma state per finding.  ``jobs`` > 1 fans
+    the per-file passes out over a process pool (finding order stays
+    deterministic: results are folded in file order, and each file's
+    findings are sorted).  Raises on unreadable lint targets (the CLI
+    maps that to exit code 2)."""
+    t0 = time.monotonic()
     root = os.path.abspath(root or package_root())
     registry = load_event_registry(
         _read(os.path.join(root, TELEMETRY_MODULE.replace("/", os.sep)))
     )
+    consumer = _read(
+        os.path.join(root, TRACE_REPORT_MODULE.replace("/", os.sep))
+    )
+    degraded_attrs, degraded_line = load_degraded_events(consumer)
+    degraded = degraded_attrs or None
     if files is None:
         rels = iter_package_files(root)
         paths = [(os.path.join(root, r.replace("/", os.sep)), r)
@@ -1150,11 +1354,24 @@ def lint_package(root: Optional[str] = None,
             paths.append((ap, rel.replace(os.sep, "/")))
         run_drift = False
     sources = [(rel, _read_strict(abspath)) for abspath, rel in paths]
-    index, trees = _build_index(sources)
     findings: List[Finding] = []
-    for rel, src in sources:
-        findings += lint_source(src, rel, registry=registry, index=index,
-                                tree=trees.get(rel))
+    njobs = default_jobs() if jobs is None else max(1, jobs)
+    if njobs > 1 and len(sources) > 1:
+        with concurrent.futures.ProcessPoolExecutor(
+            max_workers=min(njobs, len(sources)),
+            initializer=_pool_init,
+            initargs=(sources, registry, degraded),
+        ) as pool:
+            # map() yields in submission order: per-file findings fold
+            # back deterministically no matter which worker ran them
+            for batch in pool.map(_pool_lint, [rel for rel, _ in sources]):
+                findings += batch
+    else:
+        index, trees = _build_index(sources)
+        for rel, src in sources:
+            findings += lint_source(src, rel, registry=registry,
+                                    index=index, tree=trees.get(rel),
+                                    degraded=degraded)
     doc_path = os.path.join(os.path.dirname(root), ARCHITECTURE_DOC)
     if run_drift and registry is not None and os.path.exists(doc_path):
         # the drift check is a repo-time gate: an installed package
@@ -1162,17 +1379,20 @@ def lint_package(root: Optional[str] = None,
         # flagging every documented-only event there would fail a
         # correct tree.  The repo checkout always has the doc (and the
         # tier-1 suite asserts the check runs there).
-        consumer = _read(
-            os.path.join(root, TRACE_REPORT_MODULE.replace("/", os.sep))
-        )
         findings += check_registry_drift(registry, consumer, _read(doc_path))
+    if run_drift and registry is not None:
+        # RP14 reverse leg needs the whole package in view (like the
+        # registry drift check): a degraded event nobody emits
+        findings += check_degraded_drift(
+            degraded_attrs, degraded_line, registry, sources
+        )
     active = [f for f in findings
               if not f.suppressed and f.severity == "error"]
     counts: Dict[str, int] = {}
     for f in active:
         counts[f.rule] = counts.get(f.rule, 0) + 1
     return {
-        "rplint": 3,
+        "rplint": 4,
         "root": root,
         "files": len(paths),
         "findings": [f.to_dict() for f in findings],
@@ -1181,6 +1401,7 @@ def lint_package(root: Optional[str] = None,
         "unresolvable_emits": len(
             [f for f in findings if f.severity == "info"]
         ),
+        "wall_s": round(time.monotonic() - t0, 3),
         "ok": not active,
     }
 
@@ -1264,6 +1485,36 @@ def diff_baseline(report: dict, baseline: dict) -> dict:
             "ok": not new}
 
 
+def _fsync_dir(path: str) -> None:
+    """Best-effort parent-directory fsync after an ``os.replace`` (the
+    rename itself can be lost on crash without it); tolerant because
+    some filesystems refuse O_RDONLY directory opens."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_json_atomic(path: str, obj: dict) -> None:
+    """The commit idiom RP13 enforces, practiced by the linter's own
+    artifact writers: tmp sibling → flush → fsync → ``os.replace`` →
+    directory fsync."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     """CLI face (``cli lint`` delegates here).  Exit codes — the
     contract ``make lint-ci`` and the driver rely on: **0** no
@@ -1274,7 +1525,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="rplint",
         description="AST-based invariant checks for this repo's "
-                    "pipeline contracts (rules RP01-RP09; see "
+                    "pipeline contracts (rules RP01-RP14; see "
                     "randomprojection_tpu/analysis/rplint.py)",
     )
     ap.add_argument("paths", nargs="*",
@@ -1303,12 +1554,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="also write the findings as a SARIF 2.1.0 log "
                          "to PATH, so CI and editors can annotate them "
                          "inline")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="fan the per-file rule passes out over N "
+                         "processes (default: min(8, cpu)); finding "
+                         "order stays deterministic, 1 disables the "
+                         "pool")
     args = ap.parse_args(argv)
     updated: Optional[dict] = None
     try:
         if args.update_baseline and args.baseline is None:
             raise ValueError("--update-baseline requires --baseline PATH")
-        report = lint_package(args.root, files=args.paths or None)
+        report = lint_package(args.root, files=args.paths or None,
+                              jobs=args.jobs)
         if args.baseline is not None:
             if args.update_baseline and not os.path.exists(args.baseline):
                 base: dict = {"findings": []}  # first write starts empty
@@ -1325,11 +1582,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             report["baseline"] = diff_baseline(report, base)
             if args.update_baseline:
                 fresh = {k: v for k, v in report.items() if k != "baseline"}
-                tmp = args.baseline + ".tmp"
-                with open(tmp, "w", encoding="utf-8") as f:
-                    json.dump(fresh, f)
-                    f.write("\n")
-                os.replace(tmp, args.baseline)
+                _write_json_atomic(args.baseline, fresh)
                 updated = {
                     "path": args.baseline,
                     "accepted_new": len(report["baseline"]["new"]),
@@ -1337,9 +1590,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 }
                 report["baseline_updated"] = updated
         if args.sarif is not None:
-            with open(args.sarif, "w", encoding="utf-8") as f:
-                json.dump(to_sarif(report), f)
-                f.write("\n")
+            _write_json_atomic(args.sarif, to_sarif(report))
     except Exception as e:
         # never exit 0 off a crashed/partial run (ISSUE 11 satellite)
         print(f"rplint: internal error: {e}", file=sys.stderr)

@@ -282,7 +282,7 @@ def build_parser():
     q = sub.add_parser(
         "lint",
         help="rplint: AST + flow-sensitive invariant checks "
-             "(rules RP01-RP11)",
+             "(rules RP01-RP14)",
         description="Run the project's static-analysis pass "
                     "(randomprojection_tpu/analysis/rplint.py) over the "
                     "installed package: span balance, telemetry.EVENTS "
@@ -292,8 +292,13 @@ def build_parser():
                     "ops/ determinism, silently-swallowed exceptions, "
                     "Pallas DMA copy/wait/budget discipline, "
                     "cross-thread shared-state races (thread roles + "
-                    "lock regions on a shared CFG), and lock-order "
-                    "deadlock analysis.  Exit codes: 0 = no unsuppressed "
+                    "lock regions on a shared CFG), lock-order "
+                    "deadlock analysis, resource-lifecycle pairing "
+                    "(every acquire released on every path out), "
+                    "durable-commit discipline (tmp/flush/fsync/replace "
+                    "plus manifest-last ordering), and degraded-path "
+                    "contracts (every fallback rung doctor-visible and "
+                    "memoized).  Exit codes: 0 = no unsuppressed "
                     "finding (none outside the baseline when one is "
                     "given), 1 = findings, 2 = internal error "
                     "(unreadable target, malformed baseline, analysis "
@@ -326,6 +331,10 @@ def build_parser():
                    help="also write the findings as a SARIF 2.1.0 log "
                         "to PATH so CI and editors can annotate them "
                         "inline")
+    q.add_argument("--jobs", type=int, default=None, metavar="N",
+                   help="lint files across N worker processes (default: "
+                        "min(8, cpu count); 1 = serial). Findings stay "
+                        "in deterministic path order either way")
 
     q = sub.add_parser(
         "recover",
@@ -929,6 +938,8 @@ def cmd_lint(args):
         argv.append("--update-baseline")
     if args.sarif is not None:
         argv += ["--sarif", args.sarif]
+    if args.jobs is not None:
+        argv += ["--jobs", str(args.jobs)]
     return rplint.main(argv)
 
 

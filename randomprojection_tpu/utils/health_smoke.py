@@ -136,11 +136,18 @@ def _scenario_stall(tmp: str) -> list:
     timeout_s = 1.0
     recorder = telemetry.FlightRecorder()
     rec_sub = telemetry.subscribe(recorder, name="flight-recorder")
-    recorder.install(dump_path, signals=(), on_exception=False)
-    engine = health.HealthEngine(
-        slo=health.parse_slo_spec(f"stall={timeout_s},tick=0.1"),
-        recorder=recorder,
-    ).start()
+    try:
+        recorder.install(dump_path, signals=(), on_exception=False)
+        engine = health.HealthEngine(
+            slo=health.parse_slo_spec(f"stall={timeout_s},tick=0.1"),
+            recorder=recorder,
+        ).start()
+    except BaseException:
+        # the r17 bug shape: a failed downstream acquire must not leak
+        # the already-live subscription (its dispatch thread would pin
+        # the process)
+        telemetry.unsubscribe(rec_sub)
+        raise
     recorder.attach_health(engine.active)
     fails: list = []
     try:

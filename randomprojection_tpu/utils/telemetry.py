@@ -661,6 +661,24 @@ def active_path() -> Optional[str]:
     return log.path if log is not None else None
 
 
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync after an ``os.replace`` — without it
+    the rename itself can be lost on crash, which for the flight
+    recorder means losing exactly the postmortem the crash produced.
+    Tolerant: some filesystems refuse O_RDONLY directory opens, and a
+    dump must never turn into a new crash."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _finalizing() -> bool:
     """True when the interpreter is tearing down (or so far gone that we
     cannot even tell).  Emitting from a daemon thread or a ``__del__``
@@ -1162,6 +1180,7 @@ class FlightRecorder:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
+            _fsync_dir(os.path.dirname(os.path.abspath(path)))
             _DEFAULT_REGISTRY.counter_inc("telemetry.flight.dumps")
             if emit_event:
                 emit(

@@ -7,6 +7,7 @@ import threading
 def spawn():
     t = threading.Thread(target=print)  # VIOLATION: no daemon=
     q = queue.Queue()  # VIOLATION: unbounded
+    sq = queue.SimpleQueue()  # VIOLATION: unbounded by construction
     bounded = queue.Queue(maxsize=2)  # ok
     t2 = threading.Thread(target=print, daemon=True)  # ok
     t.start()
@@ -15,4 +16,4 @@ def spawn():
     t2.join()
     # rplint: allow[RP04] — fixture: suppression case
     q2 = queue.Queue()  # suppressed
-    return q, bounded, q2
+    return q, bounded, q2, sq

@@ -1,0 +1,99 @@
+"""RP12 fixture: leaked acquires and the r17 acquire-ordering shape.
+
+Expected active findings (lint under any relpath):
+- subscription leaked on the early-return path
+- open() handle leaked on the raise path
+- mkdtemp dir leaked on the early-return path
+- MetricsServer acquired unprotected while a subscription is live
+plus one pragma-suppressed leak twin; the ok twins must stay silent.
+"""
+import shutil
+import tempfile
+
+from randomprojection_tpu.utils import telemetry
+from randomprojection_tpu.utils.metrics_server import MetricsServer
+
+
+def work(*args):
+    return args
+
+
+def leak_subscription(fn, flag):
+    sub = telemetry.subscribe(fn)  # VIOLATION: early return leaks it
+    if flag:
+        return None
+    sub.close()
+    return None
+
+
+def leak_open(path):
+    f = open(path)  # VIOLATION: the raise path leaks the handle
+    data = f.read()
+    if not data:
+        raise ValueError(path)
+    f.close()
+    return data
+
+
+def leak_tmpdir(flag):
+    d = tempfile.mkdtemp()  # VIOLATION: early return leaks the dir
+    if flag:
+        return None
+    shutil.rmtree(d)
+    return None
+
+
+def ordering_pair(fn, aggregator):
+    sub = telemetry.subscribe(fn)
+    # VIOLATION below: if MetricsServer raises, sub leaks (r17 shape)
+    server = MetricsServer(port=0, aggregator=aggregator)
+    try:
+        work(server)
+    finally:
+        server.close()
+        sub.close()
+
+
+def ok_with(path):
+    with open(path) as f:  # ok: context-managed
+        return f.read()
+
+
+def ok_escape(fn):
+    sub = telemetry.subscribe(fn)
+    return sub  # ok: the handle escapes to the caller
+
+
+def ok_guarded(fn, flag):
+    sub = None
+    try:
+        if flag:
+            sub = telemetry.subscribe(fn)  # ok: guarded release below
+        work(flag)
+    finally:
+        if sub is not None:
+            sub.close()
+
+
+def ok_ordering(fn, aggregator):
+    sub = telemetry.subscribe(fn)
+    try:
+        # ok: exception-protected — the handler releases sub
+        server = MetricsServer(port=0, aggregator=aggregator)
+    except BaseException:
+        sub.close()
+        raise
+    try:
+        work(server)
+    finally:
+        server.close()
+        sub.close()
+
+
+def suppressed_leak(fn, flag):
+    # rplint: allow[RP12] — fixture: suppression case
+    sub = telemetry.subscribe(fn)  # suppressed
+    if flag:
+        return None
+    sub.close()
+    return None

@@ -1,0 +1,76 @@
+"""RP13 fixture: torn-artifact writes, unfsynced replaces, and a
+manifest committed before its chunks.
+
+Expected active findings (lint under relpath ``durable.py``):
+- raw open(final_path, "w") in-place write
+- os.replace reachable without flush/fsync on the staged tmp
+- manifest commit not dominated by the chunk writes
+- os.replace with no directory fsync reachable after it
+plus one pragma-suppressed raw-write twin; the conformant twins must
+stay silent.
+"""
+import json
+import os
+
+
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def raw_final_write(path, rec):
+    with open(path, "w") as f:  # VIOLATION: in-place final write
+        json.dump(rec, f)
+
+
+def replace_without_fsync(path, rec):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)  # VIOLATION: tmp bytes never flushed/fsynced
+    _fsync_dir(os.path.dirname(path))
+
+
+def replace_no_dirfsync(path, rec):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # VIOLATION: no directory fsync after
+
+
+def manifest_before_chunks(entries, index):
+    _write_manifest(index)  # VIOLATION: committed before the spills
+    for lo, codes in entries:
+        _write_npy_atomic(lo, codes)
+
+
+def ok_commit(path, rec):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # ok: fsynced, directory fsync below
+    _fsync_dir(os.path.dirname(path))
+
+
+def ok_manifest_last(entries, index):
+    for lo, codes in entries:
+        _write_npy_atomic(lo, codes)
+    if index:
+        _write_npy_atomic(0, index)
+    # ok: dominated by both writes via their loop/if headers (the
+    # zero-trip/nothing-to-spill shapes still commit a truthful
+    # manifest)
+    _write_manifest(index)
+
+
+def suppressed_raw_write(path, rec):
+    # rplint: allow[RP13] — fixture: suppression case
+    with open(path, "w") as f:  # suppressed
+        json.dump(rec, f)

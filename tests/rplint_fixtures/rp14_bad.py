@@ -1,0 +1,81 @@
+"""RP14 fixture: silent and unmemoized fallback rungs plus a
+counter-only fallback.
+
+Expected active findings (lint under relpath ``ann/lsh.py``):
+- silent classified rung (no emit, no recorder)
+- classified rung that emits but never memoizes the degraded key
+- counter_inc("...fallback...") with no adjacent event emit
+plus one pragma-suppressed silent-rung twin; the ok twins (including
+the ladder shape whose memo sits after the loop) must stay silent.
+Every handler re-raises on unclassified errors so RP06 stays quiet —
+this fixture isolates the RP14 legs.
+"""
+
+_NO_FUSED_KEYS = set()
+
+
+def silent_rung(plan, key, fallback):
+    try:
+        return plan(key)
+    except Exception as e:  # VIOLATION: doctor-invisible fallback
+        if not isinstance(e, MemoryError):
+            raise
+        return fallback(key)
+
+
+def unmemoized_rung(plan, key, fallback):
+    try:
+        return plan(key)
+    except Exception as e:  # VIOLATION: no degraded-key memo
+        if not isinstance(e, MemoryError):
+            raise
+        telemetry.emit(EVENTS.INDEX_LSH_FALLBACK, key=key)
+        return fallback(key)
+
+
+def counter_only(registry_, key):
+    # VIOLATION below: counter with no adjacent degraded-event emit
+    registry_.counter_inc("index.lsh.fallbacks")
+    return key
+
+
+def ok_rung(plan, key, fallback):
+    try:
+        return plan(key)
+    except Exception as e:  # ok: emits and memoizes in the handler
+        if not isinstance(e, MemoryError):
+            raise
+        _NO_FUSED_KEYS.add(key)
+        telemetry.emit(EVENTS.INDEX_LSH_FALLBACK, key=key)
+        return fallback(key)
+
+
+def ok_ladder(plans, key, no_fused_keys):
+    for idx, plan in enumerate(plans):
+        try:
+            out = plan(key)
+        except Exception as e:  # ok: memo reachable after the ladder
+            if idx == len(plans) - 1 or not isinstance(e, MemoryError):
+                raise
+            telemetry.emit(EVENTS.INDEX_LSH_FALLBACK, key=key, rung=idx)
+            continue
+        if idx:
+            no_fused_keys.add(key)
+        return out
+    raise RuntimeError("unreachable")
+
+
+def ok_counter(key):
+    counter_inc("index.lsh.fallbacks")  # ok: emit is adjacent
+    telemetry.emit(EVENTS.INDEX_LSH_FALLBACK, key=key)
+    return key
+
+
+def suppressed_rung(plan, key, fallback):
+    try:
+        return plan(key)
+    # rplint: allow[RP14] — fixture: suppression case
+    except Exception as e:  # suppressed
+        if not isinstance(e, MemoryError):
+            raise
+        return fallback(key)

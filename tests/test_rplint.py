@@ -1,13 +1,15 @@
 """rplint (ISSUE r10, grown flow-sensitive in ISSUE 11, concurrency-
-aware in ISSUE 12): every rule against its known-bad fixture, the
-pragma grammar (continuation lines, multi-rule pragmas, stale
-detection), the registry drift check, the stable --json schema (v3:
-severity + unresolvable-emit accounting), the exit-code contract
+aware in ISSUE 12, lifecycle/durability/degraded-path-aware in ISSUE
+20): every rule against its known-bad fixture, the pragma grammar
+(continuation lines, multi-rule pragmas, stale detection), the
+registry drift check, the stable --json schema (v4: wall_s + the
+process-pool fan-out's deterministic ordering), the exit-code contract
 (findings→1, clean→0, internal error→2), baseline diffing +
 --update-baseline rewriting, SARIF 2.1.0 output, the RP04/RP08 dedupe,
 and — the acceptance gate — that the shipped tree (including all four
-thread/queue substrates under RP10/RP11) lints clean through the real
-`cli lint` entry point with zero non-baselined findings."""
+thread/queue substrates under RP10/RP11 and the RP12/RP13/RP14
+contracts) lints clean through the real `cli lint` entry point with
+zero non-baselined findings."""
 
 import json
 import os
@@ -93,9 +95,13 @@ def test_rp03_hot_path_host_syncs():
 
 def test_rp04_thread_hygiene():
     active, suppressed = _split(_lint_fixture("rp04_bad.py"))
-    assert [f.rule for f in active] == ["RP04", "RP04"]
+    assert [f.rule for f in active] == ["RP04", "RP04", "RP04"]
     msgs = " | ".join(f.message for f in active)
     assert "daemon=" in msgs and "unbounded" in msgs
+    # ISSUE 20 satellite: SimpleQueue has no maxsize at all — it is
+    # flagged as unbounded-by-construction, distinct from Queue()
+    assert "SimpleQueue" in msgs and "by construction" in msgs
+    assert [f.line for f in active] == [8, 9, 10]
     assert [f.rule for f in suppressed] == ["RP04"]
 
     nojoin = _lint_fixture("rp04_nojoin.py")
@@ -381,11 +387,12 @@ def test_cli_lint_exits_zero_and_json_schema(capsys):
     assert cli.main(["lint", "--json"]) == 0
     out = capsys.readouterr().out.strip()
     rec = json.loads(out)
-    assert rec["rplint"] == 3 and rec["ok"] is True
+    assert rec["rplint"] == 4 and rec["ok"] is True
     assert set(rec) == {
         "rplint", "root", "files", "findings", "counts", "suppressed",
-        "unresolvable_emits", "ok",
+        "unresolvable_emits", "wall_s", "ok",
     }
+    assert isinstance(rec["wall_s"], float) and rec["wall_s"] >= 0.0
     assert rec["unresolvable_emits"] == 0  # the tree emits constants only
     for f in rec["findings"]:  # the suppressed ones in the tree
         assert set(f) == {
@@ -1257,7 +1264,7 @@ def test_update_baseline_rewrites_in_place(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "baseline updated" in out and "1 new finding(s) accepted" in out
     base = json.loads(basefile.read_text())
-    assert base["rplint"] == 3
+    assert base["rplint"] == 4
     assert [f["rule"] for f in base["findings"]] == ["RP04"]
     # the accepted finding now passes the diffed gate
     assert cli.main(["lint", "--baseline", str(basefile), str(bad)]) == 0
@@ -1365,6 +1372,204 @@ def test_rp11_string_join_on_variable_separator_is_not_blocking():
     assert any(
         f.rule == "RP11" and "blocking .join()" in f.message for f in fs
     ), [f.message for f in fs]
+
+
+# -- ISSUE 20: RP12 lifecycle / RP13 durable commit / RP14 degraded paths ----
+
+
+def test_rp12_fixture():
+    """Leaked acquires (subscription, open() handle, mkdtemp dir) and
+    the r17 acquire-ordering shape, each seeded exactly once; the
+    ok-twins (with-managed, escaping, guarded release, exception-
+    protected ordering) silent."""
+    active, suppressed = _split(_lint_fixture("rp12_bad.py"))
+    assert [f.rule for f in active] == ["RP12"] * 4
+    assert [f.line for f in active] == [22, 30, 39, 49]
+    msgs = [f.message for f in active]
+    joined = " | ".join(msgs)
+    assert "telemetry subscription 'sub'" in joined
+    assert "open() handle 'f'" in joined
+    assert "mkdtemp temp dir 'd'" in joined
+    assert "MetricsServer 'server' is acquired while 'sub'" in joined
+    assert "not exception-protected" in joined
+    assert sum("not released on every path out" in m for m in msgs) == 3
+    for clean in ("ok_with", "ok_escape", "ok_guarded", "ok_ordering"):
+        assert clean not in joined
+    assert [f.rule for f in suppressed] == ["RP12"]
+    assert suppressed[0].line == 95
+    assert suppressed[0].reason.startswith("fixture:")
+
+
+def test_rp13_fixture():
+    """Durable-commit discipline on a durable-plane module: raw final
+    write, unflushed replace, missing directory fsync, and a manifest
+    committed before its chunks — the conformant twins (including the
+    loop/if-promoted manifest-last shape) silent."""
+    active, suppressed = _split(
+        _lint_fixture("rp13_bad.py", relpath="durable.py")
+    )
+    assert [f.rule for f in active] == ["RP13"] * 4
+    assert [f.line for f in active] == [25, 33, 43, 47]
+    joined = " | ".join(f.message for f in active)
+    assert "raw open(..., 'w') writes the final path in place" in joined
+    assert "without a flush or an os.fsync" in joined
+    assert "no directory fsync is reachable after this os.replace" in joined
+    assert "manifest must be replaced LAST" in joined
+    for clean in ("ok_commit", "ok_manifest_last"):
+        assert clean not in joined
+    assert [f.rule for f in suppressed] == ["RP13"]
+    assert suppressed[0].line == 75
+    # outside the durable-plane modules the rule stands down
+    assert _lint_fixture("rp13_bad.py") == []
+
+
+def test_rp14_fixture():
+    """Degraded-path contracts on a fallback-bearing module: a silent
+    rung, a classified rung with no degraded-key memo, and a fallback
+    counter with no adjacent event emit — the ok-twins (handler memo,
+    memo-after-the-ladder reachable through the CFG, counter+emit
+    adjacency) silent."""
+    with open(os.path.join(FIXTURES, "rp14_bad.py")) as f:
+        src = f.read()
+    findings = rplint.lint_source(
+        src, "ann/lsh.py", degraded={"INDEX_LSH_FALLBACK"}
+    )
+    active, suppressed = _split(findings)
+    assert [f.rule for f in active] == ["RP14"] * 3
+    assert [f.line for f in active] == [20, 29, 38]
+    joined = " | ".join(f.message for f in active)
+    assert "doctor cannot see this degradation" in joined
+    assert "never memoizes the degraded key" in joined
+    assert "without an adjacent degraded-event emit" in joined
+    for clean in ("ok_rung", "ok_ladder", "ok_counter"):
+        assert clean not in joined
+    assert [f.rule for f in suppressed] == ["RP14"]
+    assert suppressed[0].line == 78
+    # without a degraded set (standalone lint) any EVENTS.* emit
+    # satisfies the forward leg — the same three findings fire
+    solo = [f for f in rplint.lint_source(src, "ann/lsh.py")
+            if f.rule == "RP14" and not f.suppressed]
+    assert [f.line for f in solo] == [20, 29, 38]
+    # outside the fallback-bearing modules the rule stands down
+    assert _lint_fixture("rp14_bad.py") == []
+
+
+def test_rp12_rp13_rp14_shipped_tree_passes():
+    """The ISSUE 20 acceptance gate: the shipped tree carries ZERO
+    RP12/RP13/RP14 findings — the real leaks the sweep caught
+    (health_smoke's unprotected HealthEngine acquire, FlightRecorder's
+    missing directory fsync, rplint's own raw baseline/SARIF writes)
+    were fixed, not suppressed."""
+    report = rplint.lint_package()
+    new = [f for f in report["findings"]
+           if f["rule"] in ("RP12", "RP13", "RP14")]
+    assert new == [], new
+
+
+def test_degraded_events_load_and_drift():
+    """RP14's reverse leg: DEGRADED_EVENTS parses out of the real
+    consumer, and the drift check flags both an unregistered member and
+    a registered-but-never-emitted member."""
+    consumer = open(os.path.join(
+        rplint.package_root(), "utils", "trace_report.py"
+    )).read()
+    attrs, line = rplint.load_degraded_events(consumer)
+    assert "INDEX_LSH_FALLBACK" in attrs and "KERNEL_DMA_FALLBACK" in attrs
+    assert len(attrs) >= 10 and line > 1
+    reg = rplint.EventRegistry(
+        events={"GOOD": "good.event"}, families=(), lines={},
+    )
+    findings = rplint.check_degraded_drift(
+        {"GOOD", "ROGUE"}, 7, reg,
+        [("a.py", "emit(EVENTS.GOOD)"), ("utils/trace_report.py", "")],
+    )
+    assert [f.rule for f in findings] == ["RP14"]
+    assert f"EVENTS.ROGUE" in findings[0].message
+    assert findings[0].line == 7
+    # a member only the consumer itself mentions is consumed-not-produced
+    findings = rplint.check_degraded_drift(
+        {"GOOD"}, 7, reg,
+        [("utils/trace_report.py", "EVENTS.GOOD")],
+    )
+    assert len(findings) == 1
+    assert "nothing raises" in findings[0].message
+    # registered and emitted: clean
+    assert rplint.check_degraded_drift(
+        {"GOOD"}, 7, reg, [("a.py", "emit(EVENTS.GOOD)")]
+    ) == []
+
+
+def test_rule_scope_sets_name_real_modules():
+    """ISSUE 20 satellite: every module the scoped rules target exists
+    on disk — a rename that silently un-scopes a rule is drift this
+    guard catches."""
+    root = rplint.package_root()
+    scoped = set()
+    for group in (rplint.HOT_MODULES, rplint.PIPELINE_MODULES,
+                  rplint.CONCURRENCY_MODULES, rplint.RP13_MODULES,
+                  rplint.RP14_MODULES, tuple(rplint.KERNEL_BUDGET_FNS)):
+        scoped.update(group)
+    assert scoped, "rule scope sets are empty"
+    missing = [rel for rel in sorted(scoped)
+               if not os.path.exists(os.path.join(root, *rel.split("/")))]
+    assert missing == [], missing
+    # the budget functions themselves still exist in their modules
+    for rel, fn in rplint.KERNEL_BUDGET_FNS.items():
+        src = open(os.path.join(root, *rel.split("/"))).read()
+        assert f"def {fn}(" in src, (rel, fn)
+
+
+def test_lint_package_jobs_deterministic():
+    """ISSUE 20 tentpole-adjacent: the process-pool fan-out returns
+    byte-identical findings in the same order as the serial path."""
+    root = rplint.package_root()
+    files = [
+        os.path.join(root, *rel.split("/"))
+        for rel in ("models/sketch.py", "utils/telemetry.py",
+                    "streaming.py", "ann/lsh.py", "tiering.py",
+                    "durable.py")
+    ]
+    serial = rplint.lint_package(files=files, jobs=1)
+    pooled = rplint.lint_package(files=files, jobs=4)
+    assert serial["findings"] == pooled["findings"]
+    assert serial["counts"] == pooled["counts"]
+    assert serial["files"] == pooled["files"] == len(files)
+    assert pooled["rplint"] == 4 and "wall_s" in pooled
+
+
+def test_rp12_pragma_and_baseline_lifecycle(tmp_path, capsys):
+    """The new rules ride the existing suppression machinery: a seeded
+    RP12 leak fails `cli lint` (exit 1), a reasoned pragma restores 0,
+    and --update-baseline accepts the unpragma'd finding."""
+    leak = (
+        "def leak(fn, flag):\n"
+        "    sub = telemetry.subscribe(fn)\n"
+        "    if flag:\n"
+        "        return None\n"
+        "    sub.close()\n"
+        "    return None\n"
+    )
+    bad = tmp_path / "seeded.py"
+    bad.write_text(leak)
+    assert cli.main(["lint", str(bad)]) == 1
+    capsys.readouterr()
+    assert cli.main(["lint", "--json", str(bad)]) == 1
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["counts"] == {"RP12": 1}
+    bad.write_text(leak.replace(
+        "    sub = telemetry.subscribe(fn)\n",
+        "    # rplint: allow[RP12] — test: caller owns the release\n"
+        "    sub = telemetry.subscribe(fn)\n",
+    ))
+    assert cli.main(["lint", str(bad)]) == 0
+    capsys.readouterr()
+    # baseline route: the raw leak is accepted, then gates clean
+    bad.write_text(leak)
+    basefile = tmp_path / "base.json"
+    assert cli.main(["lint", "--baseline", str(basefile),
+                     "--update-baseline", str(bad)]) == 0
+    capsys.readouterr()
+    assert cli.main(["lint", "--baseline", str(basefile), str(bad)]) == 0
 
 
 def test_ci_workflow_runs_lint_ci_and_fast_tier1():
